@@ -1,0 +1,36 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX imports.
+
+Mirrors the reference's envtest strategy (SURVEY.md §4): everything below
+e2e runs without real hardware. Multi-chip sharding tests use the 8 virtual
+CPU devices; real-TPU behavior is covered by bench.py / the driver's
+compile checks.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)
+
+
+@pytest.fixture
+def tmp_volume(tmp_path):
+    """A small 'PVC': a directory tree with a few files."""
+    root = tmp_path / "vol"
+    root.mkdir()
+    (root / "a.txt").write_bytes(b"hello world\n" * 100)
+    (root / "sub").mkdir()
+    (root / "sub" / "b.bin").write_bytes(bytes(range(256)) * 512)
+    (root / "empty").write_bytes(b"")
+    return root

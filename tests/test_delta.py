@@ -1,0 +1,56 @@
+"""Tests for the device-side delta-scan primitives."""
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from volsync_tpu.ops.delta import build_signature, match_offsets, verify_candidates
+from volsync_tpu.ops.rolling import weak_checksum_host
+
+
+def test_build_signature(rng):
+    data = rng.bytes(4096 + 100)
+    B = 512
+    buf = jnp.asarray(np.frombuffer(data, np.uint8))
+    weak, strong = build_signature(buf, block_len=B)
+    weak = np.asarray(weak)
+    strong = np.asarray(strong)
+    assert weak.shape[0] == 9  # 8 full + 1 tail
+    assert strong.shape == (8, 4)
+    for i in range(8):
+        assert weak[i] == weak_checksum_host(data[i * B : (i + 1) * B])
+        want = np.frombuffer(hashlib.md5(data[i * B : (i + 1) * B]).digest(), "<u4")
+        assert (strong[i] == want).all()
+
+
+def test_match_offsets_finds_shared_blocks(rng):
+    B = 512
+    old = rng.bytes(8 * B)
+    # new data: prefix junk + two blocks of old content at unaligned offsets
+    new = rng.bytes(777) + old[2 * B : 4 * B] + rng.bytes(333) + old[6 * B : 7 * B]
+    old_buf = jnp.asarray(np.frombuffer(old, np.uint8))
+    new_buf = jnp.asarray(np.frombuffer(new, np.uint8))
+    weak, strong = build_signature(old_buf, block_len=B)
+    sorted_weak = jnp.sort(weak)
+    cand, count = match_offsets(new_buf, sorted_weak, window=B, max_candidates=4096)
+    cand = np.asarray(cand)[: int(count)]
+    assert 777 in cand and 777 + B in cand and (777 + 2 * B + 333) in cand
+    # verify strong checksums at candidates agree with direct MD5
+    states = verify_candidates(new_buf, cand, block_len=B)
+    for i, c in enumerate(cand):
+        want = np.frombuffer(hashlib.md5(new[c : c + B]).digest(), "<u4")
+        assert (states[i] == want).all()
+
+
+def test_edge_cases_short_and_empty(rng):
+    """Short source buffers and empty signatures must not crash."""
+    import jax.numpy as jnp
+    from volsync_tpu.ops.rolling import rolling_weak_checksums
+
+    short = jnp.asarray(np.frombuffer(rng.bytes(8), np.uint8))
+    assert rolling_weak_checksums(short, window=16).shape == (0,)
+
+    empty_sig = jnp.zeros((0,), jnp.uint32)
+    cand, count = match_offsets(short, empty_sig, window=16, max_candidates=16)
+    assert int(count) == 0

@@ -1,0 +1,41 @@
+"""Tests for rolling / per-block weak checksums."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from volsync_tpu.ops.rolling import (
+    block_weak_checksums,
+    rolling_weak_checksums,
+    weak_checksum_host,
+)
+
+
+def test_rolling_matches_host(rng):
+    data = rng.bytes(5000)
+    W = 700
+    buf = jnp.asarray(np.frombuffer(data, np.uint8))
+    got = np.asarray(rolling_weak_checksums(buf, window=W))
+    assert got.shape[0] == 5000 - W + 1
+    for k in [0, 1, 17, 2500, 5000 - W]:
+        assert got[k] == weak_checksum_host(data[k : k + W]), k
+
+
+def test_blocks_match_host(rng):
+    data = rng.bytes(10_240 + 137)  # includes a partial tail block
+    B = 1024
+    buf = jnp.asarray(np.frombuffer(data, np.uint8))
+    got = np.asarray(block_weak_checksums(buf, block_len=B))
+    nb = (len(data) + B - 1) // B
+    assert got.shape[0] == nb
+    for i in range(nb):
+        assert got[i] == weak_checksum_host(data[i * B : (i + 1) * B]), i
+
+
+def test_rolling_equals_blocks_on_aligned_offsets(rng):
+    data = rng.bytes(8192)
+    B = 512
+    buf = jnp.asarray(np.frombuffer(data, np.uint8))
+    roll = np.asarray(rolling_weak_checksums(buf, window=B))
+    blocks = np.asarray(block_weak_checksums(buf, block_len=B))
+    for i in range(len(data) // B):
+        assert roll[i * B] == blocks[i]

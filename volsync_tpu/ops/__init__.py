@@ -1,0 +1,49 @@
+"""JAX/XLA kernels for the data-plane hot loops.
+
+These are the TPU-native replacements for the native binaries the reference
+wraps (see SURVEY.md §2.2): rsync's rolling Adler-32 weak checksum + strong
+checksum delta scan (mover-rsync/source.sh:54), restic's content-defined
+chunking + per-blob SHA-256 (mover-restic/Dockerfile:7-10), and syncthing's
+per-block SHA-256 (mover-syncthing/Dockerfile:9-21).
+
+Everything here is pure JAX (jnp / lax) on uint32 lanes so it runs on the
+TPU VPU, with bit-exact golden tests against hashlib / reference semantics.
+"""
+
+from volsync_tpu.ops.sha256 import (
+    sha256_blocks,
+    sha256_many,
+    sha256_pack_host,
+    sha256_chunks_device,
+)
+from volsync_tpu.ops.md5 import md5_blocks, md5_many
+from volsync_tpu.ops.gearcdc import (
+    GearParams,
+    gear_hash_positions,
+    cdc_candidates,
+    select_boundaries,
+    chunk_buffer,
+)
+from volsync_tpu.ops.rolling import (
+    block_weak_checksums,
+    rolling_weak_checksums,
+)
+from volsync_tpu.ops.delta import build_signature, match_offsets
+
+__all__ = [
+    "sha256_blocks",
+    "sha256_many",
+    "sha256_pack_host",
+    "sha256_chunks_device",
+    "md5_blocks",
+    "md5_many",
+    "GearParams",
+    "gear_hash_positions",
+    "cdc_candidates",
+    "select_boundaries",
+    "chunk_buffer",
+    "block_weak_checksums",
+    "rolling_weak_checksums",
+    "build_signature",
+    "match_offsets",
+]
