@@ -1,0 +1,132 @@
+"""API types: serde round-trips and condition upsert semantics."""
+
+from datetime import datetime, timedelta, timezone
+
+from volsync_tpu.api import (
+    CONDITION_SYNCHRONIZING,
+    Condition,
+    ConditionStatus,
+    CopyMethod,
+    ObjectMeta,
+    ReplicationDestination,
+    ReplicationDestinationSpec,
+    ReplicationDestinationResticSpec,
+    ReplicationSource,
+    ReplicationSourceResticSpec,
+    ReplicationSourceSpec,
+    ReplicationTrigger,
+    ResticRetainPolicy,
+    from_dict,
+    to_dict,
+)
+from volsync_tpu.api.common import find_condition, set_condition
+
+
+def make_source():
+    return ReplicationSource(
+        metadata=ObjectMeta(name="db-backup", namespace="prod"),
+        spec=ReplicationSourceSpec(
+            source_pvc="db-data",
+            trigger=ReplicationTrigger(schedule="0 * * * *"),
+            restic=ReplicationSourceResticSpec(
+                copy_method=CopyMethod.SNAPSHOT,
+                repository="restic-secret",
+                prune_interval_days=7,
+                retain=ResticRetainPolicy(daily=7, weekly=4, last=3),
+            ),
+        ),
+    )
+
+
+def test_source_roundtrip():
+    rs = make_source()
+    d = to_dict(rs)
+    # camelCase keys, None omitted
+    assert d["spec"]["sourcePvc"] == "db-data"
+    assert d["spec"]["trigger"]["schedule"] == "0 * * * *"
+    assert d["spec"]["restic"]["retain"]["daily"] == 7
+    assert "rsync" not in d["spec"]
+    back = from_dict(ReplicationSource, d)
+    assert back.spec.restic.retain.weekly == 4
+    assert back.spec.restic.copy_method is CopyMethod.SNAPSHOT
+    assert back.metadata.key == ("prod", "db-backup")
+
+
+def test_destination_roundtrip_times():
+    rd = ReplicationDestination(
+        metadata=ObjectMeta(name="dst"),
+        spec=ReplicationDestinationSpec(
+            restic=ReplicationDestinationResticSpec(
+                repository="restic-secret",
+                restore_as_of=datetime(2026, 7, 1, 12, 0, tzinfo=timezone.utc),
+                previous=1,
+            )
+        ),
+    )
+    st = rd.ensure_status()
+    st.last_sync_time = datetime(2026, 7, 2, tzinfo=timezone.utc)
+    st.last_sync_duration = timedelta(seconds=42.5)
+    back = from_dict(ReplicationDestination, to_dict(rd))
+    assert back.spec.restic.restore_as_of.year == 2026
+    assert back.status.last_sync_duration == timedelta(seconds=42.5)
+
+
+def test_unknown_keys_ignored():
+    d = to_dict(make_source())
+    d["spec"]["futureField"] = {"x": 1}
+    back = from_dict(ReplicationSource, d)
+    assert back.spec.source_pvc == "db-data"
+
+
+def test_condition_upsert_transition_time():
+    conds = []
+    set_condition(
+        conds,
+        Condition(CONDITION_SYNCHRONIZING, ConditionStatus.TRUE, "SyncInProgress"),
+    )
+    t0 = conds[0].last_transition_time
+    assert t0 is not None
+    # same status -> transition time preserved
+    set_condition(
+        conds,
+        Condition(CONDITION_SYNCHRONIZING, ConditionStatus.TRUE, "SyncInProgress", "m"),
+    )
+    assert conds[0].last_transition_time == t0
+    assert conds[0].message == "m"
+    # flipped status -> transition time bumps
+    set_condition(
+        conds,
+        Condition(CONDITION_SYNCHRONIZING, ConditionStatus.FALSE, "CleaningUp"),
+    )
+    assert conds[0].last_transition_time >= t0
+    assert len(conds) == 1
+    assert find_condition(conds, CONDITION_SYNCHRONIZING).reason == "CleaningUp"
+
+
+def test_typed_list_fields_roundtrip():
+    from volsync_tpu.api.common import SyncthingPeer
+    from volsync_tpu.api import (
+        ReplicationSourceSyncthingSpec,
+        ReplicationSourceStatus,
+    )
+    rs = make_source()
+    rs.spec.restic = None
+    rs.spec.syncthing = ReplicationSourceSyncthingSpec(
+        peers=[SyncthingPeer(address="tcp://a:22000", id="DEV1")]
+    )
+    st = rs.ensure_status()
+    set_condition(st.conditions, Condition(
+        CONDITION_SYNCHRONIZING, ConditionStatus.TRUE, "SyncInProgress"))
+    back = from_dict(ReplicationSource, to_dict(rs))
+    assert isinstance(back.spec.syncthing.peers[0], SyncthingPeer)
+    assert back.spec.syncthing.peers[0].id == "DEV1"
+    assert isinstance(back.status.conditions[0], Condition)
+    assert back.status.conditions[0].status is ConditionStatus.TRUE
+
+
+def test_enum_yaml_safe():
+    import yaml
+
+    d = to_dict(make_source())
+    y = yaml.safe_dump(d)  # must not choke on str-enums
+    assert "Snapshot" in y

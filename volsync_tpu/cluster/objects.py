@@ -1,0 +1,197 @@
+"""Resource kinds the movers build.
+
+These mirror the Kubernetes objects the reference's movers create
+(Jobs/Deployments/Services/Secrets/PVCs/VolumeSnapshots — SURVEY.md §2
+#10-13), re-expressed as plain dataclasses over the in-process cluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from datetime import datetime
+from typing import Optional
+
+from volsync_tpu.api.common import ObjectMeta
+
+
+@dataclasses.dataclass
+class VolumeSpec:
+    """PVC analogue: a named, provisioned data volume."""
+
+    capacity: Optional[int] = None              # bytes
+    access_modes: list = dataclasses.field(default_factory=list)
+    storage_class_name: Optional[str] = None
+    # PiT provenance, like PVC dataSource: {"kind": "Volume"|"VolumeSnapshot",
+    # "name": ...}
+    data_source: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class VolumeStatus:
+    phase: str = "Pending"      # Pending | Bound
+    capacity: Optional[int] = None
+    path: Optional[str] = None  # filesystem root of the provisioned volume
+
+
+@dataclasses.dataclass
+class Volume:
+    metadata: ObjectMeta
+    spec: VolumeSpec = dataclasses.field(default_factory=VolumeSpec)
+    status: VolumeStatus = dataclasses.field(default_factory=VolumeStatus)
+    kind: str = "Volume"
+
+
+@dataclasses.dataclass
+class VolumeSnapshotSpec:
+    source_volume: Optional[str] = None
+    volume_snapshot_class_name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class VolumeSnapshotStatus:
+    bound_content: Optional[str] = None   # snapshot content path once taken
+    ready_to_use: bool = False
+    restore_size: Optional[int] = None
+    creation_time: Optional[datetime] = None
+
+
+@dataclasses.dataclass
+class VolumeSnapshot:
+    metadata: ObjectMeta
+    spec: VolumeSnapshotSpec = dataclasses.field(default_factory=VolumeSnapshotSpec)
+    status: VolumeSnapshotStatus = dataclasses.field(
+        default_factory=VolumeSnapshotStatus
+    )
+    kind: str = "VolumeSnapshot"
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """The mover payload. ``entrypoint`` names a registered data-plane
+    entrypoint (the container-image analogue: the reference's Jobs run
+    /entry.sh, /source.sh, ... — SURVEY.md §2.2); ``env`` is its config,
+    ``volumes`` maps mount names to Volume object names."""
+
+    entrypoint: str = ""
+    env: dict = dataclasses.field(default_factory=dict)
+    volumes: dict = dataclasses.field(default_factory=dict)
+    secrets: dict = dataclasses.field(default_factory=dict)  # mount: secret name
+    backoff_limit: int = 2
+    parallelism: int = 1            # 0 = paused (rsync/mover.go:366-370)
+    node_selector: dict = dataclasses.field(default_factory=dict)
+    service_account: Optional[str] = None
+
+
+@dataclasses.dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    exit_code: Optional[int] = None
+    message: Optional[str] = None
+    start_time: Optional[datetime] = None
+    completion_time: Optional[datetime] = None
+
+
+@dataclasses.dataclass
+class Job:
+    metadata: ObjectMeta
+    spec: JobSpec = dataclasses.field(default_factory=JobSpec)
+    status: JobStatus = dataclasses.field(default_factory=JobStatus)
+    kind: str = "Job"
+
+
+@dataclasses.dataclass
+class ServicePort:
+    port: int
+    target_port: Optional[int] = None
+    protocol: str = "TCP"
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    type: str = "ClusterIP"  # ClusterIP | LoadBalancer
+    ports: list = dataclasses.field(default_factory=list)
+    selector: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ServiceStatus:
+    cluster_ip: Optional[str] = None
+    load_balancer_hostname: Optional[str] = None
+    load_balancer_ip: Optional[str] = None
+    bound_port: Optional[int] = None  # actual listening port of the backend
+
+
+@dataclasses.dataclass
+class Service:
+    metadata: ObjectMeta
+    spec: ServiceSpec = dataclasses.field(default_factory=ServiceSpec)
+    status: ServiceStatus = dataclasses.field(default_factory=ServiceStatus)
+    kind: str = "Service"
+
+
+@dataclasses.dataclass
+class Secret:
+    metadata: ObjectMeta
+    data: dict = dataclasses.field(default_factory=dict)  # str -> bytes
+    kind: str = "Secret"
+
+
+@dataclasses.dataclass
+class ServiceAccount:
+    metadata: ObjectMeta
+    kind: str = "ServiceAccount"
+
+
+@dataclasses.dataclass
+class DeploymentSpec:
+    """Always-on mover (the live-sync daemon runs as a Deployment, not a
+    Job — syncthing/mover.go:389-522)."""
+
+    entrypoint: str = ""
+    env: dict = dataclasses.field(default_factory=dict)
+    volumes: dict = dataclasses.field(default_factory=dict)
+    secrets: dict = dataclasses.field(default_factory=dict)
+    replicas: int = 1
+    service_account: Optional[str] = None
+
+
+@dataclasses.dataclass
+class DeploymentStatus:
+    ready_replicas: int = 0
+    message: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Deployment:
+    metadata: ObjectMeta
+    spec: DeploymentSpec = dataclasses.field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = dataclasses.field(default_factory=DeploymentStatus)
+    kind: str = "Deployment"
+
+
+@dataclasses.dataclass
+class Event:
+    """Recorded against an involved object (mover/events.go vocabulary)."""
+
+    metadata: ObjectMeta
+    involved_kind: str = ""
+    involved_name: str = ""
+    type: str = "Normal"   # Normal | Warning
+    reason: str = ""
+    action: str = ""
+    message: str = ""
+    kind: str = "Event"
+
+
+KINDS = {
+    "Volume": Volume,
+    "VolumeSnapshot": VolumeSnapshot,
+    "Job": Job,
+    "Service": Service,
+    "Secret": Secret,
+    "ServiceAccount": ServiceAccount,
+    "Deployment": Deployment,
+    "Event": Event,
+}
