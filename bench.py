@@ -1,0 +1,83 @@
+"""Driver benchmark: single-chip chunk+hash pipeline throughput.
+
+Measures the data-plane hot loop (BASELINE.json north star): gear-hash CDC
+boundary detection + per-block SHA-256 of a device-resident buffer on one
+TPU chip, against the CPU mover's equivalent (hashlib SHA-256, the engine
+inside the reference's restic/syncthing movers — SURVEY.md §2.2).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is the speedup over the single-core CPU hash path (the
+reference's unit of compute — one mover pod ≈ one core doing hashing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def device_throughput(total_mib: int = 64, block_kib: int = 1,
+                      iters: int = 5) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS
+    from volsync_tpu.parallel.engine import _single_chip_step
+
+    block_len = block_kib * 1024
+    n = total_mib * 1024 * 1024
+    rng = np.random.RandomState(7)
+    host = rng.randint(0, 256, size=(n,), dtype=np.uint8)
+    data = jnp.asarray(host)
+
+    @jax.jit
+    def run(salt):
+        # salt makes each iteration's bytes distinct: the serving tunnel
+        # memoizes executions with identical args, which would otherwise
+        # fake the timing.
+        return _single_chip_step(
+            data ^ salt, block_len=block_len, mask_s=DEFAULT_PARAMS.mask_s,
+            seed=DEFAULT_PARAMS.seed,
+        )
+
+    jax.block_until_ready(run(jnp.uint8(0)))  # compile + warm
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = run(jnp.uint8(i + 1))
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return n / dt  # bytes/s
+
+
+def cpu_baseline(total_mib: int = 32, block_kib: int = 1) -> float:
+    """hashlib SHA-256 over the same block structure, one core — what the
+    reference's mover pod spends its time on."""
+    block_len = block_kib * 1024
+    n = total_mib * 1024 * 1024
+    rng = np.random.RandomState(7)
+    host = rng.randint(0, 256, size=(n,), dtype=np.uint8).tobytes()
+    t0 = time.perf_counter()
+    for off in range(0, n, block_len):
+        hashlib.sha256(host[off : off + block_len]).digest()
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    dev = device_throughput()
+    cpu = cpu_baseline()
+    gib = dev / (1 << 30)
+    print(json.dumps({
+        "metric": "cdc_sha256_throughput_single_chip",
+        "value": round(gib, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(dev / cpu, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

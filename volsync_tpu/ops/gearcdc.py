@@ -43,9 +43,22 @@ import numpy as np
 _WINDOW = 32  # bytes of context in a 32-bit gear hash
 
 
+def _mix_u32(x):
+    """Murmur3-style finalizer: full-avalanche u32 mixing with 6 vector
+    ops — the gear table as a *function*. A 256-entry gather would
+    serialize on the TPU VPU (gathers are scalar-ish; measured ~100x
+    slower than arithmetic), so the device evaluates this directly on the
+    byte lanes and the host materializes the identical 256-entry table for
+    the scalar/streaming paths. numpy and jax.numpy both wrap mod 2^32."""
+    x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
+    x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
+    return x ^ (x >> np.uint32(16))
+
+
 def _make_gear_table(seed: int) -> np.ndarray:
-    rng = np.random.RandomState(seed)
-    return rng.randint(0, 2**32, size=256, dtype=np.uint64).astype(np.uint32)
+    b = np.arange(256, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        return _mix_u32(b + np.uint32(seed & 0xFFFFFFFF))
 
 
 def _top_mask(bits: int) -> int:
@@ -89,14 +102,15 @@ class GearParams:
 DEFAULT_PARAMS = GearParams()
 
 
-def gear_hash_positions(data: jax.Array, table: jax.Array) -> jax.Array:
+def gear_hash_positions(data: jax.Array, seed: int) -> jax.Array:
     """Gear hash at every byte position of ``data`` ([L] uint8 -> [L] uint32).
 
     Positions < 31 hash a shorter prefix window (consistent with the
     recurrence started from h=0); boundary selection never uses them because
-    min_size >= 32.
+    min_size >= 32. The per-byte table value is computed arithmetically
+    (``_mix_u32``) — no gather.
     """
-    g = table[data.astype(jnp.int32)]
+    g = _mix_u32(data.astype(jnp.uint32) + np.uint32(seed & 0xFFFFFFFF))
     h = g
     for m in (1, 2, 4, 8, 16):
         shifted = jnp.pad(h[:-m], (m, 0))
@@ -104,8 +118,9 @@ def gear_hash_positions(data: jax.Array, table: jax.Array) -> jax.Array:
     return h
 
 
-@functools.partial(jax.jit, static_argnames=("max_candidates", "mask_s", "mask_l"))
-def cdc_candidates(data: jax.Array, table: jax.Array, *,
+@functools.partial(jax.jit, static_argnames=("seed", "max_candidates",
+                                             "mask_s", "mask_l"))
+def cdc_candidates(data: jax.Array, *, seed: int,
                    mask_s: int, mask_l: int, max_candidates: int):
     """Compute compacted candidate cut positions on device.
 
@@ -115,7 +130,7 @@ def cdc_candidates(data: jax.Array, table: jax.Array, *,
     re-runs with a larger bound if truncated, keeping chunking
     deterministic).
     """
-    h = gear_hash_positions(data, table)
+    h = gear_hash_positions(data, seed)
     is_s = (h & np.uint32(mask_s)) == 0
     is_l = (h & np.uint32(mask_l)) == 0
     L = data.shape[0]
@@ -178,13 +193,12 @@ def chunk_buffer(data, params: GearParams = DEFAULT_PARAMS,
     if length <= params.min_size:
         return [(0, length)] if eof else []
     dev = jnp.asarray(data)
-    table = jnp.asarray(params.table)
     # Expected candidate density is 2^-(bits-norm) for the lax mask; leave
     # generous headroom, and retry exactly if real data is denser.
     guess = max(1024, 8 * length // max(1, params.avg_size >> (params.norm_level + 1)))
     while True:
         idx_s, count_s, idx_l, count_l = cdc_candidates(
-            dev, table, mask_s=params.mask_s, mask_l=params.mask_l,
+            dev, seed=params.seed, mask_s=params.mask_s, mask_l=params.mask_l,
             max_candidates=min(guess, length),
         )
         cs, cl = int(count_s), int(count_l)

@@ -46,12 +46,13 @@ _G = np.array(
 _A0 = np.array([0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476], dtype=np.uint32)
 
 
-def _rotl(x: jax.Array, n: int) -> jax.Array:
-    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+def _rotl(x: jax.Array, n) -> jax.Array:
+    n = n if isinstance(n, jax.Array) else np.uint32(n)
+    return (x << n) | (x >> (np.uint32(32) - n))
 
 
-def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
-    """state: [..., 4] uint32; block: [..., 16] uint32 little-endian words."""
+def _compress_unrolled(state: jax.Array, block: jax.Array) -> jax.Array:
+    """Straight-line MD5 rounds (TPU path; see sha256._compress)."""
     m = [block[..., t] for t in range(16)]
     a, b, c, d = (state[..., i] for i in range(4))
     for i in range(64):
@@ -69,6 +70,45 @@ def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
     return state + out
 
 
+def _compress_scan(state: jax.Array, block: jax.Array) -> jax.Array:
+    """Rolled MD5 rounds (CPU path — fast compile): scan over the
+    (T, S, G) tables; per-phase boolean function is a 4-way select on
+    ``i // 16``."""
+    m = jnp.moveaxis(block, -1, 0)  # [16, ...]
+    quad = tuple(state[..., i] for i in range(4))
+    xs = (
+        jnp.arange(64, dtype=jnp.int32),
+        jnp.asarray(_T),
+        jnp.asarray(_S).astype(jnp.uint32),
+        jnp.asarray(_G),
+    )
+
+    def round_step(carry, x):
+        a, b, c, d = carry
+        i, t_i, s_i, g_i = x
+        phase = i >> 2 >> 2  # i // 16
+        f = jnp.where(
+            phase == 0, (b & c) | (~b & d),
+            jnp.where(
+                phase == 1, (d & b) | (~d & c),
+                jnp.where(phase == 2, b ^ c ^ d, c ^ (b | ~d)),
+            ),
+        )
+        tmp = a + f + t_i + m[g_i]
+        return (d, b + _rotl(tmp, s_i), b, c), None
+
+    (a, b, c, d), _ = jax.lax.scan(round_step, quad, xs)
+    return state + jnp.stack([a, b, c, d], axis=-1)
+
+
+def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
+    """state: [..., 4] uint32; block: [..., 16] uint32 little-endian words.
+    Backend-selected at trace time (jit caches are per-backend)."""
+    if jax.default_backend() == "cpu":
+        return _compress_scan(state, block)
+    return _compress_unrolled(state, block)
+
+
 @jax.jit
 def md5_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
     """blocks: [B, N, 16] uint32 LE words (padded); nblocks: [B] int32.
@@ -78,6 +118,8 @@ def md5_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
     """
     B, N, _ = blocks.shape
     state0 = jnp.broadcast_to(jnp.asarray(_A0), (B, 4))
+    # Align shard_map varying-axis metadata with the input (see sha256.py).
+    state0 = state0 ^ (blocks[:, 0, :4] & jnp.uint32(0))
     xs_blocks = jnp.transpose(blocks, (1, 0, 2))
     active = (jnp.arange(N, dtype=jnp.int32)[:, None]
               < nblocks[None, :].astype(jnp.int32))

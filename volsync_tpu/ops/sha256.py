@@ -75,11 +75,9 @@ def _rotr(x: jax.Array, n: int) -> jax.Array:
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
-    """One SHA-256 compression over a batch.
-
-    state: [..., 8] uint32;  block: [..., 16] uint32 (big-endian words).
-    """
+def _compress_unrolled(state: jax.Array, block: jax.Array) -> jax.Array:
+    """Straight-line SHA-256 compression: 64 SSA rounds, schedule fully
+    unrolled. The TPU path — carries stay in vector registers."""
     w = [block[..., t] for t in range(16)]
     for t in range(16, 64):
         s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
@@ -99,6 +97,50 @@ def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
     return state + out
 
 
+def _compress_scan(state: jax.Array, block: jax.Array) -> jax.Array:
+    """Rolled SHA-256 compression: scan over 64 rounds with a rolling
+    16-word schedule window. The CPU path — XLA's CPU backend takes
+    minutes to compile the unrolled form (CPU is tests/dry-runs only,
+    where compile time matters and throughput doesn't)."""
+    K = jnp.asarray(_K)
+    w0 = jnp.moveaxis(block, -1, 0)  # [16, ...] rolling schedule window
+    abcdefgh = tuple(state[..., i] for i in range(8))
+
+    def round_step(carry, t):
+        (a, b, c, d, e, f, g, h), w = carry
+        wt = w[0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + K[t] + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        state_new = (t1 + t2, a, b, c, d + t1, e, f, g)
+        # Extend the schedule: w[t+16] from the window (FIPS 180-4 §6.2.2).
+        sw0 = _rotr(w[1], 7) ^ _rotr(w[1], 18) ^ (w[1] >> np.uint32(3))
+        sw1 = _rotr(w[14], 17) ^ _rotr(w[14], 19) ^ (w[14] >> np.uint32(10))
+        w_next = w[0] + sw0 + w[9] + sw1
+        w = jnp.concatenate([w[1:], w_next[None]], axis=0)
+        return (state_new, w), None
+
+    (final, _), _ = jax.lax.scan(
+        round_step, (abcdefgh, w0), jnp.arange(64, dtype=jnp.int32)
+    )
+    return state + jnp.stack(final, axis=-1)
+
+
+def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
+    """One SHA-256 compression over a batch.
+
+    state: [..., 8] uint32;  block: [..., 16] uint32 (big-endian words).
+    Picks the implementation by backend at trace time (jit caches are
+    per-backend, so this is safe under jit).
+    """
+    if jax.default_backend() == "cpu":
+        return _compress_scan(state, block)
+    return _compress_unrolled(state, block)
+
+
 @jax.jit
 def sha256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
     """Hash a batch of pre-padded messages.
@@ -110,6 +152,10 @@ def sha256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
     """
     B, N, _ = blocks.shape
     state0 = jnp.broadcast_to(jnp.asarray(_H0), (B, 8))
+    # XOR with a zero slice of the input so the carry inherits the input's
+    # shard_map varying-axis metadata (scan requires carry-in == carry-out;
+    # a constant init would be "unvarying" while the output varies).
+    state0 = state0 ^ (blocks[:, 0, :8] & jnp.uint32(0))
     xs_blocks = jnp.transpose(blocks, (1, 0, 2))  # [N, B, 16]
     active = (jnp.arange(N, dtype=jnp.int32)[:, None]
               < nblocks[None, :].astype(jnp.int32))  # [N, B]
