@@ -1,0 +1,261 @@
+"""State machine unit tests against an in-memory fake machine.
+
+Mirrors controllers/statemachine/machine_test.go + fake_machine.go:29-79:
+injectable Synchronize/Cleanup results, assertions on timestamp-derived
+state, trigger semantics, deadline misses, and metric hooks.
+"""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from volsync_tpu.controller import cron, statemachine as sm
+from volsync_tpu.movers.base import Result
+
+
+class FakeMachine:
+    def __init__(self, schedule=None, manual=None):
+        self.schedule = schedule
+        self.manual = manual
+        self._last_manual = None
+        self._lsst = None
+        self._lst = None
+        self._dur = None
+        self._nst = None
+        self.sync_result = Result.complete()
+        self.cleanup_result = Result.complete()
+        self.sync_calls = 0
+        self.cleanup_calls = 0
+        self.conditions = {}
+        self.oos = None
+        self.missed = 0
+        self.durations = []
+
+    def cronspec(self):
+        return self.schedule
+
+    def manual_tag(self):
+        return self.manual
+
+    def last_manual_sync(self):
+        return self._last_manual
+
+    def set_last_manual_sync(self, tag):
+        self._last_manual = tag
+
+    def last_sync_start_time(self):
+        return self._lsst
+
+    def set_last_sync_start_time(self, t):
+        self._lsst = t
+
+    def last_sync_time(self):
+        return self._lst
+
+    def set_last_sync_time(self, t):
+        self._lst = t
+
+    def last_sync_duration(self):
+        return self._dur
+
+    def set_last_sync_duration(self, d):
+        self._dur = d
+
+    def next_sync_time(self):
+        return self._nst
+
+    def set_next_sync_time(self, t):
+        self._nst = t
+
+    def set_condition(self, ctype, status, reason, message):
+        self.conditions[ctype] = (status, reason)
+
+    def synchronize(self):
+        self.sync_calls += 1
+        return self.sync_result
+
+    def cleanup(self):
+        self.cleanup_calls += 1
+        return self.cleanup_result
+
+    def set_out_of_sync(self, oos):
+        self.oos = oos
+
+    def increment_missed_intervals(self):
+        self.missed += 1
+
+    def observe_sync_duration(self, seconds):
+        self.durations.append(seconds)
+
+
+NOW = datetime(2026, 7, 29, 12, 0, 30, tzinfo=timezone.utc)
+
+
+def test_state_is_derived_from_timestamps():
+    m = FakeMachine()
+    assert sm.current_state(m) == sm.INITIAL
+    m._lsst = NOW
+    assert sm.current_state(m) == sm.SYNCHRONIZING
+    m._lsst, m._lst = None, NOW
+    assert sm.current_state(m) == sm.CLEANING_UP
+
+
+def test_no_trigger_syncs_continuously():
+    m = FakeMachine()
+    r = sm.run(m, NOW)
+    assert m.sync_calls == 1 and m.cleanup_calls == 1
+    assert m._lst == NOW
+    # tight re-sync loop (machine.go:223-240): the machine re-arms
+    # immediately (LSST set again) and requeues at once
+    assert m._lsst == NOW
+    assert r.requeue_after == timedelta(seconds=0)
+
+
+def test_in_progress_sync_keeps_start_time():
+    m = FakeMachine()
+    m.sync_result = Result.in_progress()
+    r = sm.run(m, NOW)
+    assert m._lsst == NOW and m._lst is None
+    assert r.requeue_after == timedelta(seconds=1)
+    assert m.conditions[sm.COND_SYNCHRONIZING] == (
+        True, sm.REASON_SYNC_IN_PROGRESS)
+    # next pass resumes SYNCHRONIZING (crash-restart safety)
+    m.sync_result = Result.complete()
+    later = NOW + timedelta(seconds=90)
+    sm.run(m, later)
+    assert m._lst == later
+    assert m.durations == [90.0]
+
+
+def test_schedule_trigger_waits_then_fires():
+    m = FakeMachine(schedule="*/5 * * * *")
+    r = sm.run(m, NOW)  # 12:00:30 -> next slot 12:05
+    assert m.sync_calls == 0
+    assert m._nst == datetime(2026, 7, 29, 12, 5, tzinfo=timezone.utc)
+    assert m.conditions[sm.COND_SYNCHRONIZING] == (
+        False, sm.REASON_WAITING_FOR_SCHEDULE)
+    assert 260 <= r.requeue_after.total_seconds() <= 270
+    sm.run(m, m._nst)  # slot arrives
+    assert m.sync_calls == 1
+    # completion advances the nominal slot
+    assert m._nst == datetime(2026, 7, 29, 12, 10, tzinfo=timezone.utc)
+    assert m.oos is False
+
+
+def test_manual_trigger_acks_tag():
+    m = FakeMachine(manual="v1")
+    sm.run(m, NOW)
+    assert m.sync_calls == 1 and m._last_manual == "v1"
+    r = sm.run(m, NOW)  # same tag: no re-sync
+    assert m.sync_calls == 1
+    assert m.conditions[sm.COND_SYNCHRONIZING] == (
+        False, sm.REASON_WAITING_FOR_MANUAL)
+    assert r.requeue_after is None
+    m.manual = "v2"
+    r = sm.run(m, NOW)  # transitions to SYNCHRONIZING, requeues
+    assert r.requeue_after == timedelta(seconds=0)
+    sm.run(m, NOW)
+    assert m.sync_calls == 2 and m._last_manual == "v2"
+
+
+def test_missed_deadline_increments_and_sets_out_of_sync():
+    m = FakeMachine(schedule="*/5 * * * *")
+    m.sync_result = Result.in_progress()
+    sm.run(m, NOW)
+    sm.run(m, m._nst)  # starts at 12:05, never completes
+    assert m._lsst is not None
+    # at 12:10 the *following* tick has passed -> out-of-sync gauge up
+    # (idempotent; the counter waits for the iteration to finish)
+    late = datetime(2026, 7, 29, 12, 10, 0, tzinfo=timezone.utc)
+    sm.run(m, late)
+    assert m.oos is True and m.missed == 0
+    # nominal slot must NOT move while overdue (an overdue slot fires
+    # immediately; advancing it would silently skip syncs)
+    assert m._nst == datetime(2026, 7, 29, 12, 5, tzinfo=timezone.utc)
+    # completion past the deadline counts the miss once and clears the gauge
+    m.sync_result = Result.complete()
+    sm.run(m, late + timedelta(seconds=10))
+    assert m.missed == 1 and m.oos is False
+
+
+def test_manual_beats_schedule_when_both_set():
+    m = FakeMachine(schedule="0 0 1 1 *", manual="v1")
+    sm.run(m, NOW)
+    assert m.sync_calls == 1 and m._last_manual == "v1"
+
+
+def test_outage_longer_than_interval_syncs_immediately():
+    m = FakeMachine(schedule="0 * * * *")
+    sm.run(m, NOW)  # arms nst = 13:00
+    # controller "down" until 15:20 — two slots missed
+    wake = datetime(2026, 7, 29, 15, 20, 0, tzinfo=timezone.utc)
+    sm.run(m, wake)
+    assert m.sync_calls == 1  # fired immediately on wake
+    assert m._nst == datetime(2026, 7, 29, 16, 0, tzinfo=timezone.utc)
+
+
+def test_cleanup_in_progress_requeues():
+    m = FakeMachine()
+    m.cleanup_result = Result.in_progress()
+    r = sm.run(m, NOW)
+    assert m.cleanup_calls == 1
+    assert r.requeue_after == timedelta(seconds=1)
+    assert m._lst == NOW  # sync already recorded
+
+
+def test_sync_error_sets_error_condition():
+    m = FakeMachine()
+
+    def boom():
+        raise RuntimeError("mover exploded")
+
+    m.synchronize = boom
+    with pytest.raises(RuntimeError):
+        sm.run(m, NOW)
+    assert m.conditions[sm.COND_SYNCHRONIZING] == (False, sm.REASON_ERROR)
+
+
+class TestCron:
+    def test_basic(self):
+        s = cron.parse("0 3 * * *")
+        assert s.next(datetime(2026, 7, 29, 3, 0)) == datetime(2026, 7, 30, 3, 0)
+        assert s.next(datetime(2026, 7, 29, 2, 59)) == datetime(2026, 7, 29, 3, 0)
+
+    def test_step_and_list(self):
+        s = cron.parse("1,31 */2 * * *")
+        assert s.next(datetime(2026, 1, 1, 0, 1)) == datetime(2026, 1, 1, 0, 31)
+        assert s.next(datetime(2026, 1, 1, 0, 31)) == datetime(2026, 1, 1, 2, 1)
+
+    def test_names_and_macros(self):
+        assert cron.parse("@daily").next(datetime(2026, 1, 1, 5, 0)) == (
+            datetime(2026, 1, 2, 0, 0))
+        s = cron.parse("0 0 * jan mon")
+        n = s.next(datetime(2026, 1, 1, 0, 0))
+        assert n.month == 1 and n.weekday() == 0
+
+    def test_dom_dow_vixie_or(self):
+        # both restricted -> either matches
+        s = cron.parse("0 0 15 * fri")
+        n = s.next(datetime(2026, 7, 29, 0, 0))
+        # Jul 31 2026 is a Friday, before Aug 15
+        assert n == datetime(2026, 7, 31, 0, 0)
+
+    def test_dow_seven_is_sunday(self):
+        # '5-7' = Fri,Sat,Sun; '0-7' = every day (7 aliases Sunday)
+        s = cron.parse("0 0 * * 5-7")
+        assert s.dow == frozenset({5, 6, 0})
+        assert cron.parse("0 0 * * 0-7").dow == frozenset(range(7))
+        assert cron.parse("0 0 * * 7").dow == frozenset({0})
+
+    def test_sparse_schedule_next_is_fast(self):
+        import time
+        t0 = time.perf_counter()
+        n = cron.parse("0 0 29 2 *").next(datetime(2026, 3, 1, 0, 0))
+        assert n == datetime(2028, 2, 29, 0, 0)
+        assert time.perf_counter() - t0 < 0.5
+
+    def test_invalid(self):
+        for bad in ("* * * *", "61 * * * *", "* 25 * * *", "a * * * *",
+                    "*/0 * * * *"):
+            with pytest.raises(cron.CronError):
+                cron.parse(bad)

@@ -1,0 +1,150 @@
+"""Shared controller utilities.
+
+Mirrors controllers/utils/: ownership labels (labels.go), label-based GC
+with the do-not-delete escape hatch (cleanup.go), per-CR service accounts
+(sahandler.go), secret validation + short-circuit reconcile chains
+(utils.go, reconcile.go).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from volsync_tpu.api.common import ObjectMeta
+from volsync_tpu.cluster.cluster import Cluster
+from volsync_tpu.cluster.objects import ServiceAccount
+
+# labels.go:20-107
+CREATED_BY_LABEL = "app.kubernetes.io/created-by"
+CREATED_BY_VALUE = "volsync-tpu"
+CLEANUP_LABEL = "volsync.backube/cleanup"
+DO_NOT_DELETE_LABEL = "volsync.backube/do-not-delete"
+SNAPNAME_ANNOTATION = "volsync.backube/snapname"
+
+# Kinds swept by cleanup, in dependency order (cleanup.go:48-76).
+CLEANUP_KINDS = ("Job", "Deployment", "Service", "VolumeSnapshot", "Volume",
+                 "Secret", "ServiceAccount")
+
+
+def owned_by_labels(owner) -> dict:
+    return {CREATED_BY_LABEL: CREATED_BY_VALUE,
+            "volsync.backube/owner-uid": owner.metadata.uid}
+
+
+def set_owned_by(obj, owner, cluster: Optional[Cluster] = None):
+    obj.metadata.labels.update(owned_by_labels(owner))
+    if cluster is not None:
+        cluster.set_owner(obj, owner)
+    return obj
+
+
+def mark_for_cleanup(obj, owner):
+    """cleanup.go:34-37: stamp the cleanup label with the owner's uid."""
+    obj.metadata.labels[CLEANUP_LABEL] = owner.metadata.uid
+    return obj
+
+
+def mark_old_snapshot_for_cleanup(cluster: Cluster, owner,
+                                  current_name: Optional[str]):
+    """cleanup.go:220-269: when a new latestImage snapshot appears, stamp
+    the previous one so the next cleanup pass collects it."""
+    for snap in cluster.list("VolumeSnapshot", owner.metadata.namespace,
+                             labels=owned_by_labels(owner)):
+        if current_name is not None and snap.metadata.name == current_name:
+            continue
+        mark_for_cleanup(snap, owner)
+        cluster.update(snap)
+
+
+def relinquish(cluster: Cluster, obj):
+    """Strip VolSync ownership instead of deleting (cleanup.go:95-117):
+    user-protected snapshots survive, unowned."""
+    obj.metadata.labels = {
+        k: v for k, v in obj.metadata.labels.items()
+        if k not in (CLEANUP_LABEL, CREATED_BY_LABEL,
+                     "volsync.backube/owner-uid")
+    }
+    obj.metadata.owner_references = []
+    cluster.update(obj)
+
+
+def relinquish_do_not_delete_snapshots(cluster: Cluster, owner):
+    """replicationdestination_controller.go:101 — run every reconcile."""
+    for snap in cluster.list("VolumeSnapshot", owner.metadata.namespace):
+        if (DO_NOT_DELETE_LABEL in snap.metadata.labels
+                and cluster.is_owned_by(snap, owner)):
+            relinquish(cluster, snap)
+
+
+def cleanup_objects(cluster: Cluster, owner,
+                    kinds: Iterable[str] = CLEANUP_KINDS) -> int:
+    """cleanup.go:48-76: DeleteAllOf per kind selected by the cleanup
+    label; do-not-delete snapshots are relinquished, not deleted."""
+    ns = owner.metadata.namespace
+    sel = {CLEANUP_LABEL: owner.metadata.uid}
+    n = 0
+    for kind in kinds:
+        if kind == "VolumeSnapshot":
+            for snap in cluster.list(kind, ns, labels=sel):
+                if DO_NOT_DELETE_LABEL in snap.metadata.labels:
+                    relinquish(cluster, snap)
+                else:
+                    cluster.delete(kind, ns, snap.metadata.name)
+                    n += 1
+        else:
+            n += cluster.delete_all_of(kind, ns, sel)
+    return n
+
+
+def ensure_service_account(cluster: Cluster, owner, name: str) -> ServiceAccount:
+    """sahandler.go:38-153, minus the OpenShift SCC RoleBinding — the
+    in-process substrate has no SCC analogue; the SA records per-CR
+    identity for the runner's audit trail."""
+    sa = ServiceAccount(
+        metadata=ObjectMeta(name=name, namespace=owner.metadata.namespace)
+    )
+    set_owned_by(sa, owner, cluster)
+    mark_for_cleanup(sa, owner)
+    return cluster.apply(sa)
+
+
+def get_and_validate_secret(cluster: Cluster, namespace: str, name: str,
+                            fields: Iterable[str]):
+    """utils.go:36-60."""
+    secret = cluster.try_get("Secret", namespace, name)
+    if secret is None:
+        raise ValueError(f"secret {namespace}/{name} not found")
+    missing = [f for f in fields if f not in secret.data]
+    if missing:
+        raise ValueError(
+            f"secret {namespace}/{name} missing fields: {missing}"
+        )
+    return secret
+
+
+def env_from_secret(secret, keys: Iterable[str],
+                    optional: bool = False) -> dict:
+    """utils.go:62-75: 1-for-1 secret-key -> env mapping."""
+    out = {}
+    for k in keys:
+        if k in secret.data:
+            v = secret.data[k]
+            out[k] = v.decode() if isinstance(v, bytes) else str(v)
+        elif not optional:
+            raise KeyError(f"secret {secret.metadata.key} missing {k}")
+    return out
+
+
+def get_service_address(service) -> Optional[str]:
+    """utils.go:86-100: LB hostname > LB IP > cluster IP."""
+    s = service.status
+    return s.load_balancer_hostname or s.load_balancer_ip or s.cluster_ip
+
+
+def reconcile_batch(*steps: Callable[[], bool]) -> bool:
+    """reconcile.go:38-45: run steps in order, stop at the first that
+    reports not-done; True iff all completed."""
+    for step in steps:
+        if not step():
+            return False
+    return True
