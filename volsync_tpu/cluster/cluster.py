@@ -70,6 +70,7 @@ class Cluster:
             if key in store:
                 raise Conflict(f"{obj.kind} {key} already exists")
             obj.metadata.resource_version = self._bump()
+            obj.metadata.generation = 1
             obj.metadata.creation_timestamp = datetime.now(timezone.utc)
             store[key] = obj
         self._after_write(obj)
@@ -105,6 +106,10 @@ class Cluster:
                         f"{obj.kind} {key}: field spec.{field} is immutable"
                     )
             obj.metadata.resource_version = self._bump()
+            # Spec writes advance the generation; status-subresource writes
+            # (update_status) do not — watchers that only care about spec
+            # changes key off generation, like metadata.generation in k8s.
+            obj.metadata.generation = current.metadata.generation + 1
             store[key] = obj
         self._after_write(obj)
         return obj

@@ -49,7 +49,9 @@ def _parse_atom(atom: str, lo: int, hi: int, names: dict) -> set[int]:
     dow = hi == 6
     if dow:
         hi = 7  # 7 is accepted as an alias of Sunday (vixie/robfig cron)
-    if atom in ("*", ""):
+    if atom == "":
+        raise CronError("empty list element (doubled or trailing comma)")
+    if atom == "*":
         start, end = lo, hi if not dow else 6
     elif "-" in atom:
         a, b = atom.split("-", 1)
@@ -97,25 +99,14 @@ class Schedule:
     dow_star: bool
 
     def matches(self, t: datetime) -> bool:
-        if t.minute not in self.minutes or t.hour not in self.hours:
-            return False
-        if t.month not in self.months:
-            return False
+        return (t.minute in self.minutes and t.hour in self.hours
+                and t.month in self.months and self._day_matches(t))
+
+    def _day_matches(self, t: datetime) -> bool:
         # Vixie-cron rule: if both dom and dow are restricted, either may
         # match; if only one is restricted, it must match.
         dom_ok = t.day in self.dom
-        dow_ok = ((t.weekday() + 1) % 7) in self.dow  # python Mon=0 -> cron Sun=0
-        if self.dom_star and self.dow_star:
-            return True
-        if self.dom_star:
-            return dow_ok
-        if self.dow_star:
-            return dom_ok
-        return dom_ok or dow_ok
-
-    def _day_matches(self, t: datetime) -> bool:
-        dom_ok = t.day in self.dom
-        dow_ok = ((t.weekday() + 1) % 7) in self.dow
+        dow_ok = ((t.weekday() + 1) % 7) in self.dow  # py Mon=0 -> cron Sun=0
         if self.dom_star and self.dow_star:
             return True
         if self.dom_star:

@@ -46,7 +46,7 @@ class Manager:
         self._threads: list[threading.Thread] = []
         self._lock = threading.Lock()
         self._due: list[tuple[float, tuple]] = []  # heap of (when, key)
-        self._seen_rv: dict[tuple, int] = {}
+        self._seen_gen: dict[tuple, int] = {}
         self._inflight: set[tuple] = set()
         self._cond = threading.Condition(self._lock)
 
@@ -94,9 +94,15 @@ class Manager:
                 for kind in self.reconcilers:
                     for cr in self.cluster.list(kind):
                         key = (kind,) + cr.metadata.key
-                        rv = cr.metadata.resource_version
-                        if self._seen_rv.get(key) != rv:
-                            self._seen_rv[key] = rv
+                        # Track the CR's spec *generation*, not its
+                        # resourceVersion: reconciles bump rv via status
+                        # writes (which must not re-trigger, or the loop
+                        # runs hot), and recording a post-reconcile rv
+                        # would race a concurrent user update and swallow
+                        # it. Generation only moves on spec writes.
+                        gen = cr.metadata.generation
+                        if self._seen_gen.get(key) != gen:
+                            self._seen_gen[key] = gen
                             heapq.heappush(self._due, (now, key))
                 self._cond.notify_all()
 
@@ -117,10 +123,6 @@ class Manager:
             key = (kind, namespace, name)
             try:
                 result = self.reconcilers[kind].reconcile(namespace, name)
-                cr = self.cluster.try_get(kind, namespace, name)
-                if cr is not None:
-                    with self._cond:
-                        self._seen_rv[key] = cr.metadata.resource_version
                 if result.requeue_after is not None and (
                         self.cluster.try_get(kind, namespace, name) is not None):
                     self.enqueue(kind, namespace, name,
@@ -150,7 +152,7 @@ class Manager:
                         heapq.heappush(self._due, (now + 0.05, key))
                         continue
                     if self.cluster.try_get(*key) is None:
-                        self._seen_rv.pop(key, None)
+                        self._seen_gen.pop(key, None)
                         continue
                     self._inflight.add(key)
                     return key

@@ -23,7 +23,8 @@ from volsync_tpu.cluster.cluster import Cluster
 from volsync_tpu.controller import statemachine, utils
 from volsync_tpu.controller.statemachine import ReconcileResult, Result
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS, Metrics
-from volsync_tpu.movers.base import CATALOG, Catalog, NoMoverFound
+from volsync_tpu.movers.base import (CATALOG, Catalog, MultipleMoversFound,
+                                     NoMoverFound)
 
 
 class _MachineBase:
@@ -151,15 +152,18 @@ class _ReconcilerBase:
             return ReconcileResult()  # deleted; GC is ownership-driven
         try:
             machine = self._build_machine(cr)
-        except NoMoverFound:
-            # No mover section yet (user still editing): surface and park.
+        except (NoMoverFound, MultipleMoversFound) as e:
+            # Permanent spec problem (zero or 2+ mover sections): surface
+            # it on the CR and park — retrying cannot fix a config error
+            # (the reference rejects these the same way,
+            # replicationsource_controller.go:104-119).
             cr.ensure_status()
             upsert_condition(
                 cr.status.conditions,
                 Condition(type=statemachine.COND_SYNCHRONIZING,
                           status=ConditionStatus.FALSE,
                           reason=statemachine.REASON_ERROR,
-                          message="no mover section in spec"),
+                          message=str(e)),
             )
             self.cluster.update_status(cr)
             return ReconcileResult()

@@ -133,9 +133,14 @@ def run(m: ReplicationMachine, now: Optional[datetime] = None) -> ReconcileResul
     if now is None:
         now = datetime.now(timezone.utc)
 
-    # Seed next_sync_time on first sight of a schedule (or spec change).
-    if trigger_type(m) == SCHEDULE_TRIGGER and m.next_sync_time() is None:
-        m.set_next_sync_time(_next_sync_from(m, now))
+    # Seed next_sync_time on first sight of a schedule, and re-seed when
+    # the schedule was edited out from under a stale slot (detected by the
+    # stored slot no longer being a fire time of the current cron spec —
+    # e.g. yearly -> every-5-min must not wait for Jan 1).
+    if trigger_type(m) == SCHEDULE_TRIGGER:
+        nst = m.next_sync_time()
+        if nst is None or not cron.parse(m.cronspec()).matches(nst):
+            m.set_next_sync_time(_next_sync_from(m, now))
 
     # Deadline-miss accounting (Run :50-62): while a scheduled sync is
     # overdue, only the (idempotent) out-of-sync gauge is raised here —

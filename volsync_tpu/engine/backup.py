@@ -1,0 +1,145 @@
+"""Tree backup into a dedup repository (the `restic backup` equivalent).
+
+What `/entry.sh backup` achieves in the reference (mover-restic/
+entry.sh:58-72) — walk the volume, chunk file contents, dedup blobs by
+content hash, store packs/index, record a snapshot — with the chunk+hash
+inner loop on the TPU (engine/chunker.py) instead of inside a wrapped
+binary. Unchanged-file detection against the parent snapshot (size +
+mtime_ns, restic's heuristic) skips re-reading stable data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat as stat_mod
+from pathlib import Path
+from typing import Optional
+
+from volsync_tpu.engine.chunker import (
+    DeviceChunkHasher,
+    params_from_config,
+    stream_chunks,
+)
+from volsync_tpu.repo import blobid
+from volsync_tpu.repo.repository import (
+    BLOB_DATA,
+    BLOB_TREE,
+    BackupStats,
+    Repository,
+)
+
+
+def _tree_id(tree_json: bytes) -> str:
+    return blobid.blob_id(tree_json)
+
+
+def _load_parent_files(repo: Repository, parent_tree: str,
+                       prefix: str = "") -> dict:
+    """Flatten the parent snapshot's tree into {relpath: file entry}."""
+    out = {}
+    tree = json.loads(repo.read_blob(parent_tree))
+    for entry in tree["entries"]:
+        path = f"{prefix}{entry['name']}"
+        if entry["type"] == "file":
+            out[path] = entry
+        elif entry["type"] == "dir":
+            out.update(_load_parent_files(repo, entry["subtree"], path + "/"))
+    return out
+
+
+class TreeBackup:
+    def __init__(self, repo: Repository, *, skip_if_empty: bool = True):
+        self.repo = repo
+        self.hasher = DeviceChunkHasher(
+            params_from_config(repo.chunker_params))
+        self.params = self.hasher.params
+        self.skip_if_empty = skip_if_empty
+
+    def run(self, root, *, hostname: str = "volsync",
+            tags: Optional[list] = None,
+            parent: Optional[str] = None) -> tuple[Optional[str], BackupStats]:
+        """Backup ``root`` -> (snapshot id, stats). Returns (None, stats)
+        for an empty volume when skip_if_empty (the reference's
+        "directory is empty, skipping backup" — entry.sh:44-50)."""
+        root = Path(root)
+        stats = BackupStats()
+        snaps = self.repo.list_snapshots()
+        if parent is None and snaps:
+            parent = snaps[-1][0]
+        parent_files = {}
+        parent_manifest = None
+        if parent:
+            parent_manifest = dict(snaps).get(parent)
+            if parent_manifest:
+                parent_files = _load_parent_files(
+                    self.repo, parent_manifest["tree"])
+        if self.skip_if_empty and not any(root.iterdir()):
+            return None, stats
+        tree_id = self._backup_dir(root, "", parent_files, stats)
+        manifest = {
+            "hostname": hostname,
+            "paths": [str(root)],
+            "tags": tags or [],
+            "tree": tree_id,
+            "parent": parent,
+            "stats": stats.as_dict(),
+        }
+        snap_id = self.repo.save_snapshot(manifest)
+        self.repo.flush()
+        return snap_id, stats
+
+    # -- internals ----------------------------------------------------------
+
+    def _backup_dir(self, dirpath: Path, rel: str, parent_files: dict,
+                    stats: BackupStats) -> str:
+        entries = []
+        for child in sorted(dirpath.iterdir(), key=lambda p: p.name):
+            st = child.lstat()
+            meta = {"name": child.name, "mode": st.st_mode & 0o7777,
+                    "mtime_ns": st.st_mtime_ns}
+            if stat_mod.S_ISLNK(st.st_mode):
+                entries.append({**meta, "type": "symlink",
+                                "target": os.readlink(child)})
+            elif stat_mod.S_ISDIR(st.st_mode):
+                sub = self._backup_dir(child, f"{rel}{child.name}/",
+                                       parent_files, stats)
+                entries.append({**meta, "type": "dir", "subtree": sub})
+            elif stat_mod.S_ISREG(st.st_mode):
+                entries.append({**meta, "type": "file", "size": st.st_size,
+                                "content": self._backup_file(
+                                    child, f"{rel}{child.name}", st,
+                                    parent_files, stats)})
+            # sockets/devices are skipped, as the data movers do
+        tree_json = json.dumps({"entries": entries},
+                               sort_keys=True).encode()
+        tid = _tree_id(tree_json)
+        self.repo.add_blob(BLOB_TREE, tid, tree_json, stats)
+        return tid
+
+    def _backup_file(self, path: Path, rel: str, st, parent_files: dict,
+                     stats: BackupStats) -> list[str]:
+        stats.files += 1
+        stats.bytes_scanned += st.st_size
+        prev = parent_files.get(rel)
+        if (prev is not None and prev["size"] == st.st_size
+                and prev["mtime_ns"] == st.st_mtime_ns
+                and all(self.repo.has_blob(b) for b in prev["content"])):
+            stats.blobs_dedup += len(prev["content"])
+            stats.bytes_dedup += st.st_size
+            return list(prev["content"])
+
+        content: list[str] = []
+        if st.st_size == 0:
+            return content
+        if st.st_size <= self.params.min_size:
+            data = path.read_bytes()
+            digest = blobid.blob_id(data)
+            self.repo.add_blob(BLOB_DATA, digest, data, stats)
+            return [digest]
+        with open(path, "rb") as f:
+            for chunk, digest in stream_chunks(f.read, self.params,
+                                               hasher=self.hasher):
+                self.repo.add_blob(BLOB_DATA, digest, chunk, stats)
+                content.append(digest)
+        return content

@@ -1,0 +1,173 @@
+"""Streaming CDC chunk+hash pipeline: the mover's device hot path.
+
+Replaces the chunk/hash core of the engine the reference wraps
+(mover-restic/entry.sh:63 `restic backup` — Rabin CDC + per-blob SHA-256
+on CPU): a segment of the input stream is uploaded to the device once,
+gear-hash CDC candidates and per-chunk SHA-256 digests both run on that
+resident buffer, and only (boundaries, digests) come back to the host.
+
+Streaming determinism: each segment handed to the CDC starts exactly at a
+chunk boundary, and no cut is eligible before min_size-1 >= 31 positions
+in, so every eligible position sees its full 32-byte gear window within
+the segment — boundaries are bit-identical to one-shot chunking of the
+whole stream (see ops/gearcdc.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from volsync_tpu.repo import blobid
+
+from volsync_tpu.ops.gearcdc import GearParams, cdc_candidates, select_boundaries
+from volsync_tpu.ops.sha256 import sha256_chunks_device
+
+
+def params_from_config(cfg: dict) -> GearParams:
+    return GearParams(min_size=cfg["min_size"], avg_size=cfg["avg_size"],
+                      max_size=cfg["max_size"], seed=cfg["seed"])
+
+
+def _pow2ceil(n: int, lo: int = 1) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+def _buffer_bucket(length: int) -> int:
+    """Pad target for input buffers. Shapes are static under jit, so an
+    unbounded variety of buffer lengths (every file tail is unique) would
+    mean a fresh multi-second XLA compile each — pad into a small fixed
+    set instead: pow2 up to 8 MiB, then multiples of 8 MiB."""
+    if length <= 8 * 1024 * 1024:
+        return _pow2ceil(length, 64 * 1024)
+    m = 8 * 1024 * 1024
+    return (length + m - 1) // m * m
+
+
+class DeviceChunkHasher:
+    """chunk+hash a byte buffer with one host->device upload.
+
+    All device call shapes are drawn from small bounded bucket sets
+    (padded buffer sizes, fixed candidate capacity, size-classed chunk
+    batches with pow2 lane counts) so the jit cache converges after a few
+    segments regardless of workload shape.
+    """
+
+    def __init__(self, params: GearParams):
+        self.params = params
+
+    def process(self, buffer, *, eof: bool = True) -> list[tuple[int, int, str]]:
+        """-> [(start, length, sha256-hex)] covering ``buffer`` (the tail
+        is withheld when not ``eof`` — the caller re-feeds it)."""
+        import jax.numpy as jnp
+
+        if isinstance(buffer, (bytes, bytearray, memoryview)):
+            buffer = np.frombuffer(buffer, dtype=np.uint8)
+        length = int(buffer.shape[0])
+        if length == 0:
+            return []
+        p = self.params
+        if length <= p.min_size:
+            if not eof:
+                return []
+            return [(0, length, blobid.blob_id(buffer.tobytes()))]
+
+        padded = _buffer_bucket(length)
+        if padded != length:
+            buffer = np.pad(buffer, (0, padded - length))
+        dev = jnp.asarray(buffer)
+        # Fixed candidate capacity: one boundary candidate per 64 bytes
+        # covers any mask down to 2^-6 density (avg_size >= 256B with the
+        # default normalization) — no data-dependent retry, no recompiles.
+        cap = padded // 64
+        idx_s, count_s, idx_l, count_l = cdc_candidates(
+            dev, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+            max_candidates=cap,
+        )
+        cs, cl = min(int(count_s), cap), min(int(count_l), cap)
+        idx_s = np.asarray(idx_s)[:cs]
+        idx_l = np.asarray(idx_l)[:cl]
+        # Padding bytes can only add candidates at positions >= length;
+        # drop them (cuts are decided on real data only).
+        idx_s = idx_s[idx_s < length]
+        idx_l = idx_l[idx_l < length]
+        chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
+        if not chunks:
+            return []
+        hexes = self._hash_chunks(dev, chunks)
+        return [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)]
+
+    def _hash_chunks(self, dev, chunks: list[tuple[int, int]]) -> list[str]:
+        """Merkle blob ids for (start, length) slices of the device buffer
+        (repo/blobid.py): every 4 KiB leaf of every chunk hashes as one
+        independent lane — wide batch, 65-step scan, a single compiled
+        shape — then the tiny roots combine host-side."""
+        import jax.numpy as jnp
+
+        leaf_starts: list[int] = []
+        leaf_lengths: list[int] = []
+        spans: list[tuple[int, int]] = []  # (first leaf index, count) per chunk
+        for start, length in chunks:
+            first = len(leaf_starts)
+            n = blobid.leaf_count(length)
+            for k in range(n):
+                off = k * blobid.LEAF_SIZE
+                leaf_starts.append(start + off)
+                leaf_lengths.append(min(blobid.LEAF_SIZE, length - off))
+            spans.append((first, n))
+        lanes = _pow2ceil(len(leaf_starts), 128)
+        starts = np.zeros((lanes,), np.int32)
+        lengths = np.zeros((lanes,), np.int32)
+        starts[: len(leaf_starts)] = leaf_starts
+        lengths[: len(leaf_lengths)] = leaf_lengths
+        digests = np.asarray(sha256_chunks_device(
+            dev, jnp.asarray(starts), jnp.asarray(lengths),
+            max_len=blobid.LEAF_SIZE,
+        )).astype(">u4")
+        leaf_bytes = digests.tobytes()  # 32 bytes per lane, row-major
+        out = []
+        for (first, n), (_, length) in zip(spans, chunks):
+            out.append(blobid.root_from_leaves(
+                length,
+                [leaf_bytes[32 * (first + k) : 32 * (first + k + 1)]
+                 for k in range(n)],
+            ))
+        return out
+
+
+def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
+                  segment_size: int = 32 * 1024 * 1024,
+                  hasher: Optional[DeviceChunkHasher] = None,
+                  ) -> Iterator[tuple[bytes, str]]:
+    """Chunk an arbitrary-length stream -> (chunk bytes, sha256 hex).
+
+    ``reader(n)`` returns up to n bytes, b"" at EOF. Segments are chunked
+    on device; the unterminated tail of each segment is carried into the
+    next so boundaries match one-shot chunking.
+    """
+    hasher = hasher or DeviceChunkHasher(params)
+    pending = b""
+    eof = False
+    while True:
+        while not eof and len(pending) < segment_size + params.max_size:
+            piece = reader(segment_size)
+            if not piece:
+                eof = True
+            else:
+                pending += piece
+        consumed = 0
+        for start, length, digest in hasher.process(
+                np.frombuffer(pending, np.uint8), eof=eof):
+            yield pending[start : start + length], digest
+            consumed = start + length
+        pending = pending[consumed:]
+        if eof:
+            return
+        # A non-eof pass over more than max_size bytes always emits at
+        # least one chunk (max_size forces a cut), so progress is
+        # guaranteed; assert to fail loudly rather than loop forever.
+        assert consumed > 0, "chunker made no progress"

@@ -1,0 +1,97 @@
+"""Snapshot restore (the `restic restore` equivalent).
+
+What `/entry.sh restore` does in the reference (mover-restic/
+entry.sh:203-229): select a snapshot by RESTORE_AS_OF / SELECT_PREVIOUS
+(here: Repository.select_snapshot), then materialize its tree into the
+target volume. Restores are idempotent: existing identical files are
+skipped by size+content check of the first blob, and extra files in the
+target can optionally be deleted (--delete semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+from volsync_tpu.repo.repository import Repository
+
+
+class TreeRestore:
+    def __init__(self, repo: Repository):
+        self.repo = repo
+
+    def run(self, snap_id: str, manifest: dict, dest,
+            *, delete_extra: bool = True) -> dict:
+        dest = Path(dest)
+        dest.mkdir(parents=True, exist_ok=True)
+        stats = {"files": 0, "bytes": 0, "skipped": 0, "deleted": 0}
+        self._restore_tree(manifest["tree"], dest, stats,
+                           delete_extra=delete_extra)
+        return stats
+
+    def _restore_tree(self, tree_id: str, dirpath: Path, stats: dict,
+                      *, delete_extra: bool):
+        tree = json.loads(self.repo.read_blob(tree_id))
+        wanted = {e["name"] for e in tree["entries"]}
+        if delete_extra:
+            for child in dirpath.iterdir():
+                if child.name not in wanted:
+                    _rmtree(child)
+                    stats["deleted"] += 1
+        for entry in tree["entries"]:
+            target = dirpath / entry["name"]
+            if entry["type"] == "dir":
+                if target.is_symlink() or (target.exists() and not target.is_dir()):
+                    target.unlink()
+                target.mkdir(exist_ok=True)
+                self._restore_tree(entry["subtree"], target, stats,
+                                   delete_extra=delete_extra)
+                os.chmod(target, entry["mode"])
+                os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
+            elif entry["type"] == "symlink":
+                if target.is_symlink() or target.exists():
+                    _rmtree(target)
+                os.symlink(entry["target"], target)
+            elif entry["type"] == "file":
+                self._restore_file(entry, target, stats)
+
+    def _restore_file(self, entry: dict, target: Path, stats: dict):
+        if (target.is_file() and not target.is_symlink()
+                and target.stat().st_size == entry["size"]
+                and target.stat().st_mtime_ns == entry["mtime_ns"]):
+            stats["skipped"] += 1
+            return
+        if target.is_symlink() or target.is_dir():
+            _rmtree(target)
+        with open(target, "wb") as f:
+            for blob_id in entry["content"]:
+                f.write(self.repo.read_blob(blob_id))
+        os.chmod(target, entry["mode"])
+        os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
+        stats["files"] += 1
+        stats["bytes"] += entry["size"]
+
+
+def _rmtree(path: Path):
+    import shutil
+
+    if path.is_symlink() or path.is_file():
+        path.unlink()
+    else:
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def restore_snapshot(repo: Repository, dest, *,
+                     restore_as_of=None, previous: int = 0,
+                     delete_extra: bool = True) -> Optional[dict]:
+    """Select + restore in one call; returns stats or None if no snapshot
+    matches the selectors."""
+    selected = repo.select_snapshot(restore_as_of=restore_as_of,
+                                    previous=previous)
+    if selected is None:
+        return None
+    snap_id, manifest = selected
+    return TreeRestore(repo).run(snap_id, manifest, dest,
+                                 delete_extra=delete_extra)

@@ -1,0 +1,18 @@
+"""Object-store abstraction for repository backends.
+
+The reference's restic/rclone movers talk HTTPS to any S3-compatible
+endpoint via ~35 passthrough env vars (controllers/mover/restic/
+mover.go:317-364). Here the store is a minimal key/value interface with a
+filesystem implementation (the MinIO-in-kind analogue of the e2e tier —
+hack/run-minio.sh) and an in-memory one for tests; a real S3 client can
+slot in behind the same interface when network egress exists.
+"""
+
+from volsync_tpu.objstore.store import (
+    FsObjectStore,
+    MemObjectStore,
+    ObjectStore,
+    open_store,
+)
+
+__all__ = ["ObjectStore", "FsObjectStore", "MemObjectStore", "open_store"]

@@ -1,0 +1,149 @@
+"""Key/value object stores: filesystem-backed and in-memory.
+
+Keys are slash-separated paths (``data/ab/abcdef...``). Writes are
+atomic (temp file + rename) so a crashed backup never leaves a torn
+object — the repository layer relies on this for its crash-consistency
+story (objects are immutable once visible, like S3 PUTs).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from pathlib import Path
+from typing import Iterator, Optional, Protocol
+
+
+class ObjectStore(Protocol):
+    def put(self, key: str, data: bytes) -> None: ...
+    def get(self, key: str) -> bytes: ...
+    def get_range(self, key: str, offset: int, length: int) -> bytes: ...
+    def exists(self, key: str) -> bool: ...
+    def delete(self, key: str) -> None: ...
+    def list(self, prefix: str = "") -> Iterator[str]: ...
+    def size(self, key: str) -> int: ...
+
+
+class NoSuchKey(KeyError):
+    pass
+
+
+def _check_key(key: str):
+    parts = key.split("/")
+    if any(p in ("", ".", "..") for p in parts):
+        raise ValueError(f"invalid object key {key!r}")
+
+
+class FsObjectStore:
+    """Directory-backed store; the shape of the S3 bucket the reference's
+    movers write to, minus the network."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        _check_key(key)
+        return self.root / key
+
+    def put(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
+        tmp.write_bytes(data)
+        tmp.rename(p)  # atomic visibility
+
+    def get(self, key: str) -> bytes:
+        try:
+            return self._path(key).read_bytes()
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        """Ranged read (S3 Range-GET analogue) — how blob fetches avoid
+        pulling whole packs."""
+        try:
+            with open(self._path(key), "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+
+    def exists(self, key: str) -> bool:
+        return self._path(key).is_file()
+
+    def delete(self, key: str) -> None:
+        try:
+            self._path(key).unlink()
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                if f.startswith(".tmp."):
+                    continue
+                key = str(Path(dirpath, f).relative_to(self.root))
+                key = key.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    yield key
+
+    def size(self, key: str) -> int:
+        try:
+            return self._path(key).stat().st_size
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+
+
+class MemObjectStore:
+    """In-memory store for unit tests (the fake backend of SURVEY.md §4)."""
+
+    def __init__(self):
+        self._objs: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, data: bytes) -> None:
+        _check_key(key)
+        with self._lock:
+            self._objs[key] = bytes(data)
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            try:
+                return self._objs[key]
+            except KeyError:
+                raise NoSuchKey(key) from None
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        return self.get(key)[offset : offset + length]
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._objs
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._objs.pop(key, None)
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        with self._lock:
+            keys = sorted(self._objs)
+        for k in keys:
+            if k.startswith(prefix):
+                yield k
+
+    def size(self, key: str) -> int:
+        return len(self.get(key))
+
+
+def open_store(url: str) -> ObjectStore:
+    """Open a store by URL: ``file:///path``, ``mem:`` or a bare path.
+
+    (An ``s3://`` scheme would slot in here; this environment has no
+    egress, so it is intentionally not wired.)
+    """
+    if url.startswith("mem:"):
+        return MemObjectStore()
+    if url.startswith("file://"):
+        return FsObjectStore(url[len("file://"):])
+    return FsObjectStore(url)

@@ -1,0 +1,436 @@
+"""Content-addressed deduplicating repository (restic-equivalent semantics).
+
+Clean-room design with the same capability envelope as the engine the
+reference wraps (SURVEY.md §2.2 #25: CDC chunking, per-blob SHA-256 ids,
+AES encryption, pack/index/snapshot objects, retain policy + prune,
+point-in-time restore selection): blobs keyed by the SHA-256 of their
+plaintext, grouped into immutable pack objects; index objects map blob id
+-> (pack, offset); snapshot manifests reference a tree blob. Formats are
+msgpack/json + zstd, sealed by repo/crypto.py when a password is set.
+
+Layout in the object store:
+    config                      repo id, chunker params, KDF salt+verifier
+    data/<p2>/<pack-id>         packs: sealed blob segments + sealed header
+    index/<id>                  sealed, compressed index delta
+    snapshots/<id>              sealed snapshot manifest
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time as time_mod
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta, timezone
+from typing import Iterable, Optional
+
+import zstandard
+
+from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
+from volsync_tpu.repo import blobid, crypto
+
+BLOB_DATA = "data"
+BLOB_TREE = "tree"
+
+_VERIFIER_PLAINTEXT = b"volsync-tpu repository key verifier v1"
+_COMPRESS_MIN_GAIN = 0.9  # keep compressed form only if <= 90% of raw
+
+
+class RepoError(RuntimeError):
+    pass
+
+
+@dataclass
+class IndexEntry:
+    pack: str
+    type: str
+    offset: int
+    length: int       # stored (sealed) length
+    raw_length: int   # plaintext length
+
+
+@dataclass
+class BackupStats:
+    files: int = 0
+    bytes_scanned: int = 0
+    blobs_new: int = 0
+    bytes_new: int = 0       # plaintext bytes newly stored
+    bytes_stored: int = 0    # stored (compressed+sealed) bytes
+    blobs_dedup: int = 0
+    bytes_dedup: int = 0
+
+    def as_dict(self):
+        return self.__dict__.copy()
+
+
+class Repository:
+    PACK_TARGET = 16 * 1024 * 1024
+
+    def __init__(self, store: ObjectStore, box, config: dict):
+        self.store = store
+        self.box = box
+        self.config = config
+        self._index: dict[str, IndexEntry] = {}
+        self._lock = threading.RLock()
+        self._cur_segments: list[bytes] = []
+        self._cur_entries: list[dict] = []
+        self._cur_size = 0
+        self._pending_index: dict[str, list[dict]] = {}
+        self._zc = zstandard.ZstdCompressor(level=3)
+        self._zd = zstandard.ZstdDecompressor()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @classmethod
+    def init(cls, store: ObjectStore, password: Optional[str] = None,
+             chunker: Optional[dict] = None) -> "Repository":
+        if store.exists("config"):
+            raise RepoError("repository already initialized")
+        import os
+
+        salt = os.urandom(16) if password else None
+        box = crypto.make_box(password, salt or b"")
+        config = {
+            "version": 1,
+            "id": hashlib.sha256(os.urandom(32)).hexdigest(),
+            "chunker": chunker or {"min_size": 512 * 1024,
+                                   "avg_size": 1024 * 1024,
+                                   "max_size": 8 * 1024 * 1024,
+                                   "seed": 0x5EED_CDC1},
+            "salt": salt.hex() if salt else None,
+            "verifier": box.seal(_VERIFIER_PLAINTEXT).hex() if password else None,
+        }
+        store.put("config", json.dumps(config).encode())
+        return cls(store, box, config)
+
+    @classmethod
+    def open(cls, store: ObjectStore,
+             password: Optional[str] = None) -> "Repository":
+        try:
+            config = json.loads(store.get("config"))
+        except NoSuchKey:
+            raise RepoError("no repository at this location "
+                            "(missing config)") from None
+        if config.get("salt"):
+            if not password:
+                raise crypto.WrongPassword("repository is encrypted")
+            box = crypto.make_box(password, bytes.fromhex(config["salt"]))
+            try:
+                if box.open(bytes.fromhex(config["verifier"])) != _VERIFIER_PLAINTEXT:
+                    raise crypto.WrongPassword("bad password")
+            except crypto.IntegrityError:
+                raise crypto.WrongPassword("bad password") from None
+        else:
+            box = crypto.PlainBox()
+        repo = cls(store, box, config)
+        repo.load_index()
+        return repo
+
+    @property
+    def chunker_params(self) -> dict:
+        return dict(self.config["chunker"])
+
+    # -- index --------------------------------------------------------------
+
+    def load_index(self):
+        with self._lock:
+            self._index.clear()
+            for key in self.store.list("index/"):
+                payload = json.loads(
+                    self._zd.decompress(self.box.open(self.store.get(key)))
+                )
+                for pack_id, entries in payload["packs"].items():
+                    for e in entries:
+                        self._index[e["id"]] = IndexEntry(
+                            pack=pack_id, type=e["type"], offset=e["offset"],
+                            length=e["length"], raw_length=e["raw_length"],
+                        )
+
+    def has_blob(self, blob_id: str) -> bool:
+        with self._lock:
+            return blob_id in self._index
+
+    def blob_ids(self) -> set:
+        with self._lock:
+            return set(self._index)
+
+    # -- write path ---------------------------------------------------------
+
+    def _encode_blob(self, data: bytes) -> bytes:
+        comp = self._zc.compress(data)
+        if len(comp) <= len(data) * _COMPRESS_MIN_GAIN:
+            return self.box.seal(b"\x01" + comp)
+        return self.box.seal(b"\x00" + data)
+
+    def _decode_blob(self, sealed: bytes) -> bytes:
+        plain = self.box.open(sealed)
+        if plain[:1] == b"\x01":
+            return self._zd.decompress(plain[1:])
+        return plain[1:]
+
+    def add_blob(self, btype: str, blob_id: str, data: bytes,
+                 stats: Optional[BackupStats] = None) -> bool:
+        """Store a blob unless present. Returns True if newly stored."""
+        with self._lock:
+            if blob_id in self._index:
+                if stats:
+                    stats.blobs_dedup += 1
+                    stats.bytes_dedup += len(data)
+                return False
+            seg = self._encode_blob(data)
+            self._cur_entries.append({
+                "id": blob_id, "type": btype, "offset": self._cur_size,
+                "length": len(seg), "raw_length": len(data),
+            })
+            self._cur_segments.append(seg)
+            self._cur_size += len(seg)
+            # visible to dedup immediately (pack id filled at flush)
+            self._index[blob_id] = IndexEntry(
+                pack="", type=btype, offset=self._cur_entries[-1]["offset"],
+                length=len(seg), raw_length=len(data),
+            )
+            if stats:
+                stats.blobs_new += 1
+                stats.bytes_new += len(data)
+                stats.bytes_stored += len(seg)
+            if self._cur_size >= self.PACK_TARGET:
+                self._flush_pack()
+            return True
+
+    def _flush_pack(self):
+        if not self._cur_segments:
+            return
+        body = b"".join(self._cur_segments)
+        header = self.box.seal(
+            self._zc.compress(json.dumps(self._cur_entries).encode())
+        )
+        blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
+        pack_id = hashlib.sha256(blob).hexdigest()
+        self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
+        for e in self._cur_entries:
+            self._index[e["id"]].pack = pack_id
+        self._pending_index[pack_id] = self._cur_entries
+        self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
+
+    def flush(self):
+        """Flush the open pack and persist an index delta."""
+        with self._lock:
+            self._flush_pack()
+            if not self._pending_index:
+                return
+            payload = self.box.seal(self._zc.compress(json.dumps(
+                {"packs": self._pending_index}
+            ).encode()))
+            idx_id = hashlib.sha256(payload).hexdigest()
+            self.store.put(f"index/{idx_id}", payload)
+            self._pending_index = {}
+
+    # -- read path ----------------------------------------------------------
+
+    def read_blob(self, blob_id: str) -> bytes:
+        with self._lock:
+            entry = self._index.get(blob_id)
+            if entry is None:
+                raise RepoError(f"blob {blob_id} not in index")
+            if entry.pack == "":  # still buffered in the open pack
+                for e, seg in zip(self._cur_entries, self._cur_segments):
+                    if e["id"] == blob_id:
+                        return self._decode_blob(seg)
+                raise RepoError(f"blob {blob_id} buffered but missing")
+        sealed = self.store.get_range(
+            f"data/{entry.pack[:2]}/{entry.pack}", entry.offset, entry.length
+        )
+        data = self._decode_blob(sealed)
+        got = blobid.blob_id(data)
+        if got != blob_id:
+            raise crypto.IntegrityError(
+                f"blob {blob_id}: content hash mismatch ({got})"
+            )
+        return data
+
+    # -- snapshots ----------------------------------------------------------
+
+    def save_snapshot(self, manifest: dict) -> str:
+        manifest.setdefault("time", datetime.now(timezone.utc).isoformat())
+        payload = self.box.seal(json.dumps(manifest).encode())
+        snap_id = hashlib.sha256(payload).hexdigest()
+        self.store.put(f"snapshots/{snap_id}", payload)
+        return snap_id
+
+    def list_snapshots(self) -> list[tuple[str, dict]]:
+        out = []
+        for key in self.store.list("snapshots/"):
+            snap_id = key.split("/", 1)[1]
+            manifest = json.loads(self.box.open(self.store.get(key)))
+            out.append((snap_id, manifest))
+        out.sort(key=lambda kv: kv[1]["time"])
+        return out
+
+    def delete_snapshot(self, snap_id: str):
+        self.store.delete(f"snapshots/{snap_id}")
+
+    def select_snapshot(self, restore_as_of: Optional[datetime] = None,
+                        previous: int = 0) -> Optional[tuple[str, dict]]:
+        """Point-in-time selection (mover-restic/entry.sh:146-200
+        semantics): newest snapshot with time <= restore_as_of, then step
+        back ``previous`` more."""
+        snaps = self.list_snapshots()
+        if restore_as_of is not None:
+            snaps = [s for s in snaps
+                     if datetime.fromisoformat(s[1]["time"]) <= restore_as_of]
+        if not snaps:
+            return None
+        idx = len(snaps) - 1 - previous
+        if idx < 0:
+            return None
+        return snaps[idx]
+
+    # -- retention / GC -----------------------------------------------------
+
+    def forget(self, *, last: Optional[int] = None,
+               hourly: Optional[int] = None, daily: Optional[int] = None,
+               weekly: Optional[int] = None, monthly: Optional[int] = None,
+               yearly: Optional[int] = None,
+               within: Optional[timedelta] = None) -> list[str]:
+        """Apply a restic-style retain policy; returns deleted snapshot ids
+        (restic ``forget`` — the FORGET_OPTIONS the reference builds in
+        controllers/mover/restic/mover.go:440-471)."""
+        snaps = self.list_snapshots()
+        if not snaps:
+            return []
+        keep: set[str] = set()
+        newest_time = datetime.fromisoformat(snaps[-1][1]["time"])
+        if last:
+            keep.update(sid for sid, _ in snaps[-last:])
+        if within:
+            keep.update(
+                sid for sid, m in snaps
+                if datetime.fromisoformat(m["time"]) >= newest_time - within
+            )
+        buckets = (
+            (hourly, "%Y-%m-%d-%H"), (daily, "%Y-%m-%d"),
+            (weekly, "%G-%V"), (monthly, "%Y-%m"), (yearly, "%Y"),
+        )
+        for count, fmt in buckets:
+            if not count:
+                continue
+            seen: dict[str, str] = {}
+            for sid, m in snaps:  # ascending: later overwrites keep newest
+                seen[datetime.fromisoformat(m["time"]).strftime(fmt)] = sid
+            for bucket_key in sorted(seen, reverse=True)[:count]:
+                keep.add(seen[bucket_key])
+        if not keep:  # a policy that keeps nothing keeps the newest
+            keep.add(snaps[-1][0])
+        doomed = [sid for sid, _ in snaps if sid not in keep]
+        for sid in doomed:
+            self.delete_snapshot(sid)
+        return doomed
+
+    def referenced_blobs(self) -> set:
+        """Walk all snapshot trees; returns reachable blob ids."""
+        reachable: set[str] = set()
+        stack = []
+        for _, manifest in self.list_snapshots():
+            stack.append(manifest["tree"])
+        while stack:
+            tree_id = stack.pop()
+            if tree_id in reachable:
+                continue
+            reachable.add(tree_id)
+            tree = json.loads(self.read_blob(tree_id))
+            for entry in tree["entries"]:
+                if entry["type"] == "dir":
+                    stack.append(entry["subtree"])
+                elif entry["type"] == "file":
+                    reachable.update(entry["content"])
+        return reachable
+
+    def prune(self) -> dict:
+        """Drop unreferenced blobs by rewriting partially-live packs
+        (restic ``prune`` — cadence governed by the mover's
+        prune_interval_days, SURVEY.md §2 #12).
+
+        Crash-safety ordering — data is never deleted before its
+        replacement is durable:
+          1. rewrite live blobs of partially-live packs into new packs
+             and FLUSH them;
+          2. write the consolidated index;
+          3. delete superseded index deltas;
+          4. sweep pack objects not referenced by the new index (this
+             also collects orphans left by a crash in an earlier prune).
+        A crash between any steps leaves a repository where every
+        snapshot still restores.
+        """
+        with self._lock:
+            self.flush()
+            reachable = self.referenced_blobs()
+            by_pack: dict[str, list[str]] = {}
+            for blob_id, e in self._index.items():
+                by_pack.setdefault(e.pack, []).append(blob_id)
+            removed_blobs = 0
+            rewritten = 0
+            for pack_id, blob_ids in by_pack.items():
+                live = [b for b in blob_ids if b in reachable]
+                if len(live) == len(blob_ids):
+                    continue
+                for blob_id in live:  # re-add under the new pack generation
+                    data = self.read_blob(blob_id)
+                    entry = self._index.pop(blob_id)
+                    self.add_blob(entry.type, blob_id, data)
+                for blob_id in set(blob_ids) - set(live):
+                    self._index.pop(blob_id, None)
+                    removed_blobs += 1
+                rewritten += 1
+            self._flush_pack()  # step 1 durable before anything is deleted
+            # Step 2: consolidated full index.
+            full: dict[str, list[dict]] = {}
+            for blob_id, e in self._index.items():
+                full.setdefault(e.pack, []).append({
+                    "id": blob_id, "type": e.type, "offset": e.offset,
+                    "length": e.length, "raw_length": e.raw_length,
+                })
+            payload = self.box.seal(self._zc.compress(
+                json.dumps({"packs": full}).encode()
+            ))
+            new_index_key = f"index/{hashlib.sha256(payload).hexdigest()}"
+            self.store.put(new_index_key, payload)
+            # Step 3: drop superseded deltas.
+            for key in list(self.store.list("index/")):
+                if key != new_index_key:
+                    self.store.delete(key)
+            # Step 4: sweep unreferenced pack objects.
+            live_packs = {f"data/{p[:2]}/{p}" for p in full}
+            for key in list(self.store.list("data/")):
+                if key not in live_packs:
+                    self.store.delete(key)
+            self._pending_index = {}
+            return {"packs_rewritten": rewritten,
+                    "blobs_removed": removed_blobs,
+                    "snapshots": len(self.list_snapshots())}
+
+    # -- verification -------------------------------------------------------
+
+    def check(self, read_data: bool = False) -> list[str]:
+        """Structural check (restic ``check``): every indexed blob's pack
+        exists; with read_data, every blob decrypts and re-hashes to its id."""
+        problems = []
+        with self._lock:
+            entries = dict(self._index)
+        for blob_id, e in entries.items():
+            key = f"data/{e.pack[:2]}/{e.pack}"
+            if not e.pack:
+                problems.append(f"blob {blob_id}: unflushed")
+                continue
+            if not self.store.exists(key):
+                problems.append(f"blob {blob_id}: pack {e.pack} missing")
+                continue
+            if read_data:
+                try:
+                    self.read_blob(blob_id)
+                except Exception as ex:  # noqa: BLE001 — report, don't die
+                    problems.append(f"blob {blob_id}: {ex}")
+        for _, manifest in self.list_snapshots():
+            if manifest["tree"] not in entries:
+                problems.append(f"snapshot tree {manifest['tree']} missing")
+        return problems
