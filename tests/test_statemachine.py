@@ -34,6 +34,9 @@ class FakeMachine:
     def cronspec(self):
         return self.schedule
 
+    def creation_time(self):
+        return None
+
     def manual_tag(self):
         return self.manual
 

@@ -91,9 +91,11 @@ class Manager:
             last_gen = self.cluster.generation
             now = time.monotonic()
             with self._cond:
+                live: set[tuple] = set()
                 for kind in self.reconcilers:
                     for cr in self.cluster.list(kind):
                         key = (kind,) + cr.metadata.key
+                        live.add(key)
                         # Track the CR's spec *generation*, not its
                         # resourceVersion: reconciles bump rv via status
                         # writes (which must not re-trigger, or the loop
@@ -104,6 +106,11 @@ class Manager:
                         if self._seen_gen.get(key) != gen:
                             self._seen_gen[key] = gen
                             heapq.heappush(self._due, (now, key))
+                # Forget deleted CRs so a same-name recreation (which
+                # restarts at generation 1) is seen as new, not stale.
+                for key in list(self._seen_gen):
+                    if key not in live:
+                        del self._seen_gen[key]
                 self._cond.notify_all()
 
     def enqueue(self, kind: str, namespace: str, name: str, delay: float = 0.0):

@@ -46,6 +46,9 @@ class _MachineBase:
         t = self._trigger()
         return t.schedule if t else None
 
+    def creation_time(self):
+        return self.cr.metadata.creation_timestamp
+
     def manual_tag(self) -> Optional[str]:
         t = self._trigger()
         return t.manual if t else None

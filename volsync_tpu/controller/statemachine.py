@@ -57,6 +57,7 @@ class ReplicationMachine(Protocol):
     """Status-field abstraction over both CR kinds (interface.go:31-57)."""
 
     def cronspec(self) -> Optional[str]: ...
+    def creation_time(self) -> Optional[datetime]: ...
     def manual_tag(self) -> Optional[str]: ...
     def last_manual_sync(self) -> Optional[str]: ...
     def set_last_manual_sync(self, tag: Optional[str]) -> None: ...
@@ -133,13 +134,20 @@ def run(m: ReplicationMachine, now: Optional[datetime] = None) -> ReconcileResul
     if now is None:
         now = datetime.now(timezone.utc)
 
-    # Seed next_sync_time on first sight of a schedule, and re-seed when
-    # the schedule was edited out from under a stale slot (detected by the
-    # stored slot no longer being a fire time of the current cron spec —
-    # e.g. yearly -> every-5-min must not wait for Jan 1).
+    # The nominal slot is recomputed every pass from a stable anchor
+    # (last sync completion, else CR creation), so schedule edits take
+    # effect immediately — a stale far-future slot is never trusted, and
+    # an overdue slot stays in the past and fires at once. This mirrors
+    # the reference recomputing nextSyncTime from lastSyncTime each
+    # reconcile (machine.go:280-297) rather than persisting a guess.
     if trigger_type(m) == SCHEDULE_TRIGGER:
-        nst = m.next_sync_time()
-        if nst is None or not cron.parse(m.cronspec()).matches(nst):
+        anchor = m.last_sync_time() or m.creation_time()
+        if anchor is not None:
+            m.set_next_sync_time(cron.parse(m.cronspec()).next(anchor))
+        elif m.next_sync_time() is None:
+            # No stable anchor (no sync yet, no creation stamp): seed once
+            # from now; re-deriving from a moving 'now' could slide the
+            # slot forever past each fire time.
             m.set_next_sync_time(_next_sync_from(m, now))
 
     # Deadline-miss accounting (Run :50-62): while a scheduled sync is

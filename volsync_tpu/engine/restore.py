@@ -3,9 +3,9 @@
 What `/entry.sh restore` does in the reference (mover-restic/
 entry.sh:203-229): select a snapshot by RESTORE_AS_OF / SELECT_PREVIOUS
 (here: Repository.select_snapshot), then materialize its tree into the
-target volume. Restores are idempotent: existing identical files are
-skipped by size+content check of the first blob, and extra files in the
-target can optionally be deleted (--delete semantics).
+target volume. Restores are idempotent: existing files matching the
+snapshot entry's size+mtime_ns are skipped (mode still re-applied), and
+extra files in the target can optionally be deleted (--delete semantics).
 """
 
 from __future__ import annotations
@@ -61,6 +61,10 @@ class TreeRestore:
         if (target.is_file() and not target.is_symlink()
                 and target.stat().st_size == entry["size"]
                 and target.stat().st_mtime_ns == entry["mtime_ns"]):
+            # Content is trusted unchanged (size+mtime_ns, the same
+            # heuristic backup uses), but mode can drift without touching
+            # mtime (chmod updates only ctime) — re-apply it.
+            os.chmod(target, entry["mode"])
             stats["skipped"] += 1
             return
         if target.is_symlink() or target.is_dir():

@@ -1,0 +1,64 @@
+"""Shared mover plumbing: job lifecycle, naming, poll-to-result.
+
+Captures the Job-handling behavior every reference mover repeats:
+create-or-adopt the mover Job, treat paused as parallelism 0
+(rsync/mover.go:366-370), poll until succeeded, and on exhausted backoff
+delete + recreate fresh (rsync/mover.go:436-443).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from volsync_tpu.api.common import ObjectMeta
+from volsync_tpu.cluster.objects import Job, JobSpec
+from volsync_tpu.controller import utils
+from volsync_tpu.movers.base import Result
+
+
+def mover_name(prefix: str, owner) -> str:
+    return f"volsync-{prefix}-{owner.metadata.name}"
+
+
+def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
+                  volumes: dict, secrets: Optional[dict] = None,
+                  backoff_limit: int = 2, paused: bool = False,
+                  service_account: Optional[str] = None,
+                  node_selector: Optional[dict] = None) -> Optional[Job]:
+    """Ensure the mover Job exists with the desired payload; return it
+    once it has succeeded, None while still in progress.
+
+    Failure handling matches the reference: when failures exceed the
+    backoff limit the Job is deleted and recreated from scratch so the
+    next reconcile retries cleanly (utils/reconcile.go + mover.go:436-443).
+    """
+    desired = JobSpec(
+        entrypoint=entrypoint, env=dict(env), volumes=dict(volumes),
+        secrets=dict(secrets or {}), backoff_limit=backoff_limit,
+        parallelism=0 if paused else 1,
+        node_selector=dict(node_selector or {}),
+        service_account=service_account,
+    )
+    existing = cluster.try_get("Job", owner.metadata.namespace, name)
+    if existing is not None and existing.status.failed > backoff_limit:
+        cluster.record_event(owner, "Warning", "TransferFailed",
+                             f"job {name} exceeded backoff limit; recreating",
+                             "Recreating")
+        cluster.delete("Job", owner.metadata.namespace, name)
+        existing = None
+    job = Job(metadata=ObjectMeta(name=name,
+                                  namespace=owner.metadata.namespace),
+              spec=desired)
+    utils.set_owned_by(job, owner, cluster)
+    utils.mark_for_cleanup(job, owner)
+    job = cluster.apply(job)
+    if job.status.succeeded > 0:
+        return job
+    return None
+
+
+def job_result(job: Optional[Job]) -> Result:
+    """Map ensure_job output to a state-machine Result."""
+    if job is None:
+        return Result.in_progress()
+    return Result.complete()

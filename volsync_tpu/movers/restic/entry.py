@@ -1,0 +1,111 @@
+"""restic mover data-plane entrypoint (the /entry.sh analogue).
+
+Dispatches on DIRECTION the way mover-restic/entry.sh dispatches on its
+argv verb: ``backup`` ensures the repository exists (probe, then init on
+"no repository" — entry.sh:42-57), skips empty volumes, backs up with
+the TPU engine, applies FORGET_* retention, and optionally prunes;
+``restore`` selects a snapshot via RESTORE_AS_OF / SELECT_PREVIOUS and
+materializes it. Config arrives exclusively via env + mounts, preserving
+the reference's process boundary.
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import datetime, timedelta
+
+from volsync_tpu.engine import TreeBackup, restore_snapshot
+from volsync_tpu.objstore import open_store
+from volsync_tpu.repo.repository import RepoError, Repository
+
+log = logging.getLogger("volsync_tpu.mover.restic")
+
+
+def _parse_within(value: str) -> timedelta:
+    """Duration strings like '3h30m', '2d', '1h' (restic --keep-within)."""
+    units = {"d": 86400, "h": 3600, "m": 60, "s": 1}
+    total = 0.0
+    num = ""
+    for ch in value:
+        if ch.isdigit() or ch == ".":
+            num += ch
+        elif ch in units and num:
+            total += float(num) * units[ch]
+            num = ""
+        else:
+            raise ValueError(f"bad duration {value!r}")
+    if num:  # bare number = seconds
+        total += float(num)
+    return timedelta(seconds=total)
+
+
+def _open_or_init(env: dict) -> Repository:
+    store = open_store(env["RESTIC_REPOSITORY"])
+    password = env.get("RESTIC_PASSWORD") or None
+    try:
+        return Repository.open(store, password=password)
+    except RepoError:
+        log.info("repository not initialized; creating (entry.sh:52-57)")
+        return Repository.init(store, password=password)
+
+
+def _forget_kwargs(env: dict) -> dict:
+    kw = {}
+    for key, name in (("FORGET_LAST", "last"), ("FORGET_HOURLY", "hourly"),
+                      ("FORGET_DAILY", "daily"), ("FORGET_WEEKLY", "weekly"),
+                      ("FORGET_MONTHLY", "monthly"),
+                      ("FORGET_YEARLY", "yearly")):
+        if env.get(key):
+            kw[name] = int(env[key])
+    if env.get("FORGET_WITHIN"):
+        kw["within"] = _parse_within(env["FORGET_WITHIN"])
+    return kw
+
+
+def restic_entrypoint(ctx) -> int:
+    env = ctx.env
+    direction = env.get("DIRECTION", "backup")
+    for required in ("RESTIC_REPOSITORY",):
+        if required not in env:
+            log.error("missing env %s (entry.sh:232-240)", required)
+            return 2
+    data = ctx.mounts["data"]
+
+    if direction == "backup":
+        if not any(data.iterdir()):
+            log.info("source is empty, skipping backup (entry.sh:44-50)")
+            return 0
+        repo = _open_or_init(env)
+        snap_id, stats = TreeBackup(repo).run(
+            data, hostname=env.get("HOSTNAME", "volsync"))
+        log.info("backup snapshot=%s stats=%s", snap_id, stats.as_dict())
+        kw = _forget_kwargs(env)
+        if kw:
+            removed = repo.forget(**kw)
+            log.info("forget removed %d snapshots", len(removed))
+        if env.get("PRUNE") == "1":
+            report = repo.prune()
+            log.info("prune: %s", report)
+        return 0
+
+    if direction == "prune":
+        repo = _open_or_init(env)
+        log.info("prune: %s", repo.prune())
+        return 0
+
+    if direction == "restore":
+        repo = Repository.open(open_store(env["RESTIC_REPOSITORY"]),
+                               password=env.get("RESTIC_PASSWORD") or None)
+        as_of = (datetime.fromisoformat(env["RESTORE_AS_OF"])
+                 if env.get("RESTORE_AS_OF") else None)
+        previous = int(env.get("SELECT_PREVIOUS", "0"))
+        out = restore_snapshot(repo, data, restore_as_of=as_of,
+                               previous=previous)
+        if out is None:
+            log.error("no snapshot matches the restore selectors")
+            return 3
+        log.info("restore: %s", out)
+        return 0
+
+    log.error("unknown DIRECTION %r", direction)
+    return 2

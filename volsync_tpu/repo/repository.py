@@ -41,6 +41,11 @@ class RepoError(RuntimeError):
     pass
 
 
+def _parse_time(value: str) -> datetime:
+    t = datetime.fromisoformat(value)
+    return t.replace(tzinfo=timezone.utc) if t.tzinfo is None else t
+
+
 @dataclass
 class IndexEntry:
     pack: str
@@ -264,7 +269,9 @@ class Repository:
             snap_id = key.split("/", 1)[1]
             manifest = json.loads(self.box.open(self.store.get(key)))
             out.append((snap_id, manifest))
-        out.sort(key=lambda kv: kv[1]["time"])
+        # Chronological, not lexicographic: manifests may carry non-UTC
+        # offsets, where the ISO strings don't sort by instant.
+        out.sort(key=lambda kv: _parse_time(kv[1]["time"]))
         return out
 
     def delete_snapshot(self, snap_id: str):
@@ -277,8 +284,12 @@ class Repository:
         back ``previous`` more."""
         snaps = self.list_snapshots()
         if restore_as_of is not None:
+            if restore_as_of.tzinfo is None:
+                # Naive selector (e.g. RESTORE_AS_OF without an offset):
+                # interpret as UTC rather than crash on aware-vs-naive.
+                restore_as_of = restore_as_of.replace(tzinfo=timezone.utc)
             snaps = [s for s in snaps
-                     if datetime.fromisoformat(s[1]["time"]) <= restore_as_of]
+                     if _parse_time(s[1]["time"]) <= restore_as_of]
         if not snaps:
             return None
         idx = len(snaps) - 1 - previous
@@ -413,7 +424,9 @@ class Repository:
 
     def check(self, read_data: bool = False) -> list[str]:
         """Structural check (restic ``check``): every indexed blob's pack
-        exists; with read_data, every blob decrypts and re-hashes to its id."""
+        exists; every blob reachable from any snapshot (sub-trees and
+        file content included) is present in the index; with read_data,
+        every indexed blob decrypts and re-hashes to its id."""
         problems = []
         with self._lock:
             entries = dict(self._index)
@@ -430,7 +443,33 @@ class Repository:
                     self.read_blob(blob_id)
                 except Exception as ex:  # noqa: BLE001 — report, don't die
                     problems.append(f"blob {blob_id}: {ex}")
-        for _, manifest in self.list_snapshots():
-            if manifest["tree"] not in entries:
-                problems.append(f"snapshot tree {manifest['tree']} missing")
+        # Deep reachability: a snapshot is restorable only if its whole
+        # tree closure resolves through the index.
+        seen: set[str] = set()
+        for snap_id, manifest in self.list_snapshots():
+            stack = [manifest["tree"]]
+            while stack:
+                tree_id = stack.pop()
+                if tree_id in seen:
+                    continue
+                seen.add(tree_id)
+                if tree_id not in entries:
+                    problems.append(
+                        f"snapshot {snap_id}: tree {tree_id} not in index")
+                    continue
+                try:
+                    tree = json.loads(self.read_blob(tree_id))
+                except Exception as ex:  # noqa: BLE001
+                    problems.append(f"snapshot {snap_id}: tree {tree_id}: {ex}")
+                    continue
+                for entry in tree["entries"]:
+                    if entry["type"] == "dir":
+                        stack.append(entry["subtree"])
+                    elif entry["type"] == "file":
+                        for b in entry["content"]:
+                            if b not in entries and b not in seen:
+                                seen.add(b)
+                                problems.append(
+                                    f"snapshot {snap_id}: data blob {b} "
+                                    "not in index")
         return problems
