@@ -1,0 +1,83 @@
+"""Delta-sync engine tests: reconstruction correctness and delta efficiency."""
+
+import numpy as np
+import pytest
+
+from volsync_tpu.engine.deltasync import (
+    apply_delta,
+    build_file_signature,
+    compute_delta,
+    delta_stats,
+    pick_block_len,
+)
+
+
+def roundtrip(src: bytes, dst: bytes, block_len=4096):
+    sig = build_file_signature(dst, block_len)
+    ops = compute_delta(src, sig)
+    out = apply_delta(ops, dst, sig.block_len)
+    assert out == src
+    return ops, sig
+
+
+def test_identical_files_send_no_literals(rng):
+    data = rng.bytes(100_000)
+    ops, sig = roundtrip(data, data)
+    stats = delta_stats(ops, sig.block_len)
+    assert stats["literal_bytes"] == 0
+    # copies only (full blocks coalesced into one op + the tail block)
+    assert all(op[0] == "copy" for op in ops)
+    assert len(ops) <= 2
+
+
+def test_insert_in_middle_sends_only_insert(rng):
+    dst = rng.bytes(200_000)
+    insert = rng.bytes(500)
+    src = dst[:100_000] + insert + dst[100_000:]
+    ops, sig = roundtrip(src, dst)
+    stats = delta_stats(ops, sig.block_len)
+    # literals bounded by insert + one split block each side
+    assert stats["literal_bytes"] <= len(insert) + 2 * sig.block_len
+
+
+def test_append_and_prepend(rng):
+    dst = rng.bytes(64_000)
+    src = b"HDR" + dst + b"TRL"
+    ops, sig = roundtrip(src, dst)
+    assert delta_stats(ops, sig.block_len)["literal_bytes"] <= 3 + 3 + sig.block_len
+
+
+def test_empty_and_tiny_files(rng):
+    roundtrip(b"", b"")
+    roundtrip(b"", rng.bytes(10_000))
+    roundtrip(b"x", b"")
+    roundtrip(rng.bytes(100), rng.bytes(77))
+
+
+def test_completely_different_files(rng):
+    src, dst = rng.bytes(50_000), rng.bytes(50_000)
+    ops, sig = roundtrip(src, dst)
+    assert delta_stats(ops, sig.block_len)["copied_bytes"] == 0
+
+
+def test_tail_block_matches(rng):
+    # dst has a short tail; src ends with the same tail -> copy, not literal
+    dst = rng.bytes(4096 * 3 + 1000)
+    src = rng.bytes(2000) + dst
+    ops, sig = roundtrip(src, dst)
+    assert ops[-1][0] == "copy"
+    assert ops[-1][1] == 3  # the tail block index
+
+
+def test_duplicate_blocks_in_destination(rng):
+    block = rng.bytes(4096)
+    dst = block * 4
+    src = block * 6
+    ops, sig = roundtrip(src, dst)
+    assert delta_stats(ops, sig.block_len)["literal_bytes"] == 0
+
+
+def test_block_len_heuristic():
+    assert pick_block_len(0) == 4096
+    assert pick_block_len(10_000_000) >= 4096
+    assert pick_block_len(1 << 40) == 128 * 1024

@@ -32,13 +32,6 @@ def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
     backoff limit the Job is deleted and recreated from scratch so the
     next reconcile retries cleanly (utils/reconcile.go + mover.go:436-443).
     """
-    desired = JobSpec(
-        entrypoint=entrypoint, env=dict(env), volumes=dict(volumes),
-        secrets=dict(secrets or {}), backoff_limit=backoff_limit,
-        parallelism=0 if paused else 1,
-        node_selector=dict(node_selector or {}),
-        service_account=service_account,
-    )
     existing = cluster.try_get("Job", owner.metadata.namespace, name)
     if existing is not None and existing.status.failed > backoff_limit:
         cluster.record_event(owner, "Warning", "TransferFailed",
@@ -46,15 +39,33 @@ def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
                              "Recreating")
         cluster.delete("Job", owner.metadata.namespace, name)
         existing = None
-    job = Job(metadata=ObjectMeta(name=name,
-                                  namespace=owner.metadata.namespace),
-              spec=desired)
+    if existing is not None:
+        # The Job template is treated as immutable once created (k8s Job
+        # semantics): only pause/unpause is applied. In particular the env
+        # that RAN is preserved, so callers reading job.spec.env after
+        # completion see the payload the entrypoint actually executed
+        # with, not this pass's recomputed desire. Each sync iteration
+        # gets a fresh Job (cleanup collects the old one), picking up the
+        # new desired spec then.
+        want_par = 0 if paused else 1
+        if existing.spec.parallelism != want_par:
+            existing.spec.parallelism = want_par
+            existing = cluster.update(existing)
+        return existing if existing.status.succeeded > 0 else None
+    job = Job(
+        metadata=ObjectMeta(name=name, namespace=owner.metadata.namespace),
+        spec=JobSpec(
+            entrypoint=entrypoint, env=dict(env), volumes=dict(volumes),
+            secrets=dict(secrets or {}), backoff_limit=backoff_limit,
+            parallelism=0 if paused else 1,
+            node_selector=dict(node_selector or {}),
+            service_account=service_account,
+        ),
+    )
     utils.set_owned_by(job, owner, cluster)
     utils.mark_for_cleanup(job, owner)
-    job = cluster.apply(job)
-    if job.status.succeeded > 0:
-        return job
-    return None
+    job = cluster.create(job)
+    return job if job.status.succeeded > 0 else None
 
 
 def job_result(job: Optional[Job]) -> Result:
@@ -62,3 +73,26 @@ def job_result(job: Optional[Job]) -> Result:
     if job is None:
         return Result.in_progress()
     return Result.complete()
+
+
+def ensure_cache_volume(cluster, owner, spec, name: str):
+    """Dedicated mover cache volume with the reference's fallback chain
+    (cache_* fields, else the data volume options — restic/mover.go:
+    154-193). Not marked for cleanup: it persists across iterations and
+    is collected with the CR via ownership."""
+    from volsync_tpu.cluster.objects import Volume, VolumeSpec
+
+    default_capacity = 1 * 1024 * 1024 * 1024  # 1Gi
+    vol = Volume(
+        metadata=ObjectMeta(name=name, namespace=owner.metadata.namespace),
+        spec=VolumeSpec(
+            capacity=getattr(spec, "cache_capacity", None) or default_capacity,
+            access_modes=(list(getattr(spec, "cache_access_modes", []))
+                          or list(getattr(spec, "access_modes", []))),
+            storage_class_name=(getattr(spec, "cache_storage_class_name", None)
+                                or getattr(spec, "storage_class_name", None)),
+        ),
+    )
+    utils.set_owned_by(vol, owner, cluster)
+    vol = cluster.apply(vol)
+    return vol if vol.status.phase == "Bound" else None

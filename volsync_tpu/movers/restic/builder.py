@@ -20,7 +20,11 @@ from volsync_tpu.controller import utils
 from volsync_tpu.controller.volumehandler import VolumeHandler
 from volsync_tpu.movers import base
 from volsync_tpu.movers.base import Result
-from volsync_tpu.movers.common import mover_name, reconcile_job
+from volsync_tpu.movers.common import (
+    ensure_cache_volume,
+    mover_name,
+    reconcile_job,
+)
 
 MOVER_NAME = "restic"
 REPO_SECRET_FIELDS = ("RESTIC_REPOSITORY", "RESTIC_PASSWORD")
@@ -103,31 +107,21 @@ class ResticSourceMover:
     # -- helpers -------------------------------------------------------------
 
     def _ensure_cache(self) -> Optional[Volume]:
-        """Dedicated cache volume (restic/mover.go:154-193)."""
-        name = mover_name("cache", self.owner)
-        vol = Volume(
-            metadata=ObjectMeta(name=name,
-                                namespace=self.owner.metadata.namespace),
-            spec=VolumeSpec(
-                capacity=self.spec.cache_capacity or DEFAULT_CACHE_CAPACITY,
-                access_modes=(list(self.spec.cache_access_modes)
-                              or list(self.spec.access_modes)),
-                storage_class_name=(self.spec.cache_storage_class_name
-                                    or self.spec.storage_class_name),
-            ),
-        )
-        utils.set_owned_by(vol, self.owner, self.cluster)
-        vol = self.cluster.apply(vol)
-        return vol if vol.status.phase == "Bound" else None
+        return ensure_cache_volume(self.cluster, self.owner, self.spec,
+                                   mover_name("cache", self.owner))
 
     def _should_prune(self) -> bool:
-        """Prune cadence vs status.restic.last_pruned
-        (shouldPrune, restic/mover.go:427-438)."""
+        """Prune cadence vs status.restic.last_pruned; the first prune
+        anchors to the CR's creation so it fires one interval in
+        (shouldPrune, restic/mover.go:427-438 — anchoring to creation
+        avoids the never-prunes cycle of waiting for a stamp that only a
+        prune can write)."""
         days = self.spec.prune_interval_days or DEFAULT_PRUNE_INTERVAL_DAYS
         st = self.owner.status
-        last = st.restic.last_pruned if (st and st.restic) else None
+        last = (st.restic.last_pruned if (st and st.restic) else None) \
+            or self.owner.metadata.creation_timestamp
         if last is None:
-            return False  # first prune waits one full interval
+            return False
         return datetime.now(timezone.utc) - last >= timedelta(days=days)
 
 
@@ -183,24 +177,15 @@ class ResticDestinationMover:
         return Result.complete_with_image(image)
 
     def cleanup(self) -> Result:
+        # Superseded latestImage snapshots are label-selected; the current
+        # image has no cleanup label and survives.
         utils.cleanup_objects(self.cluster, self.owner,
-                              kinds=("Job", "Volume"))
+                              kinds=("Job", "VolumeSnapshot", "Volume"))
         return Result.complete()
 
     def _ensure_cache(self) -> Optional[Volume]:
-        name = mover_name("dst-cache", self.owner)
-        vol = Volume(
-            metadata=ObjectMeta(name=name,
-                                namespace=self.owner.metadata.namespace),
-            spec=VolumeSpec(
-                capacity=self.spec.cache_capacity or DEFAULT_CACHE_CAPACITY,
-                access_modes=list(self.spec.cache_access_modes),
-                storage_class_name=self.spec.cache_storage_class_name,
-            ),
-        )
-        utils.set_owned_by(vol, self.owner, self.cluster)
-        vol = self.cluster.apply(vol)
-        return vol if vol.status.phase == "Bound" else None
+        return ensure_cache_volume(self.cluster, self.owner, self.spec,
+                                   mover_name("dst-cache", self.owner))
 
 
 class Builder:
