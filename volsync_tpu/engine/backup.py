@@ -61,7 +61,21 @@ class TreeBackup:
             parent: Optional[str] = None) -> tuple[Optional[str], BackupStats]:
         """Backup ``root`` -> (snapshot id, stats). Returns (None, stats)
         for an empty volume when skip_if_empty (the reference's
-        "directory is empty, skipping backup" — entry.sh:44-50)."""
+        "directory is empty, skipping backup" — entry.sh:44-50).
+
+        Holds a shared repository lock so a concurrent prune (exclusive)
+        can never sweep this backup's freshly written packs.
+        """
+        with self.repo.lock(exclusive=False):
+            # Re-read the index now that the lock is held: entries loaded
+            # before it could reference packs a prune swept in between,
+            # and dedup'ing against those would produce a snapshot whose
+            # blobs no longer exist (restic reloads after locking too).
+            self.repo.load_index()
+            return self._run_locked(root, hostname=hostname, tags=tags,
+                                    parent=parent)
+
+    def _run_locked(self, root, *, hostname, tags, parent):
         root = Path(root)
         stats = BackupStats()
         snaps = self.repo.list_snapshots()
@@ -85,8 +99,12 @@ class TreeBackup:
             "parent": parent,
             "stats": stats.as_dict(),
         }
-        snap_id = self.repo.save_snapshot(manifest)
+        # Durability order matters (restic's invariant): packs and index
+        # deltas must hit the store BEFORE the snapshot that references
+        # them becomes visible, or a crash in between leaves a snapshot
+        # pointing at unwritten blobs that poisons every later backup.
         self.repo.flush()
+        snap_id = self.repo.save_snapshot(manifest)
         return snap_id, stats
 
     # -- internals ----------------------------------------------------------

@@ -80,21 +80,28 @@ class DeviceChunkHasher:
         if padded != length:
             buffer = np.pad(buffer, (0, padded - length))
         dev = jnp.asarray(buffer)
-        # Fixed candidate capacity: one boundary candidate per 64 bytes
-        # covers any mask down to 2^-6 density (avg_size >= 256B with the
-        # default normalization) — no data-dependent retry, no recompiles.
+        # Candidate capacity: one boundary candidate per 64 bytes covers
+        # any mask down to 2^-6 density (avg_size >= 256B with the
+        # default normalization), so ordinary data never retries; only
+        # candidate-dense adversarial data takes the doubling path below.
         cap = padded // 64
-        idx_s, count_s, idx_l, count_l = cdc_candidates(
-            dev, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
-            max_candidates=cap,
-        )
-        cs, cl = min(int(count_s), cap), min(int(count_l), cap)
+        while True:
+            # valid_len masks the zero-padded tail on device: padding can
+            # neither add candidates nor inflate the overflow counts.
+            idx_s, count_s, idx_l, count_l = cdc_candidates(
+                dev, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+                max_candidates=cap, valid_len=length,
+            )
+            cs, cl = int(count_s), int(count_l)
+            if cs <= cap and cl <= cap:
+                break
+            # Candidate-dense (e.g. adversarial) data overflowed the
+            # capacity: silently truncating would make streaming
+            # boundaries diverge from one-shot chunking. Retry with a
+            # doubled cap (rare; costs one recompile when it happens).
+            cap = _pow2ceil(max(cs, cl), cap * 2)
         idx_s = np.asarray(idx_s)[:cs]
         idx_l = np.asarray(idx_l)[:cl]
-        # Padding bytes can only add candidates at positions >= length;
-        # drop them (cuts are decided on real data only).
-        idx_s = idx_s[idx_s < length]
-        idx_l = idx_l[idx_l < length]
         chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
         if not chunks:
             return []

@@ -170,7 +170,14 @@ def compute_delta(src: bytes, sig: FileSignature) -> list[Op]:
             pos += block_len
             lit_start = pos
         else:
-            pos += 1
+            # No verified match at pos: jump straight to the next verified
+            # offset instead of advancing byte-by-byte — the unmatched
+            # region is already covered by lit_start, and a per-byte
+            # Python loop would cost O(file bytes) interpreter steps.
+            if oi < len(offsets) and offsets[oi] > pos:
+                pos = offsets[oi]
+            else:
+                break
     if lit_start < L:
         ops.append(("data", src[lit_start:]))
     return _with_tail_match(src, sig, ops)

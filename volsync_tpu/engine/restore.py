@@ -24,6 +24,16 @@ class TreeRestore:
 
     def run(self, snap_id: str, manifest: dict, dest,
             *, delete_extra: bool = True) -> dict:
+        # Shared lock: a concurrent exclusive prune must not repack and
+        # delete the packs this restore is mid-way through reading.
+        # restore_snapshot() already holds the lock and calls _run_locked
+        # directly (selection and walk under ONE lock, not two).
+        with self.repo.lock(exclusive=False):
+            return self._run_locked(snap_id, manifest, dest,
+                                    delete_extra=delete_extra)
+
+    def _run_locked(self, snap_id: str, manifest: dict, dest,
+                    *, delete_extra: bool = True) -> dict:
         dest = Path(dest)
         dest.mkdir(parents=True, exist_ok=True)
         stats = {"files": 0, "bytes": 0, "skipped": 0, "deleted": 0}
@@ -91,11 +101,19 @@ def restore_snapshot(repo: Repository, dest, *,
                      restore_as_of=None, previous: int = 0,
                      delete_extra: bool = True) -> Optional[dict]:
     """Select + restore in one call; returns stats or None if no snapshot
-    matches the selectors."""
-    selected = repo.select_snapshot(restore_as_of=restore_as_of,
-                                    previous=previous)
-    if selected is None:
-        return None
-    snap_id, manifest = selected
-    return TreeRestore(repo).run(snap_id, manifest, dest,
-                                 delete_extra=delete_extra)
+    matches the selectors.
+
+    Selection happens under the same shared lock as the tree walk (shared
+    locks nest), and the index is re-read once locked — otherwise a prune
+    between select and walk could delete the chosen snapshot's packs and
+    the restore would die mid-way with delete_extra damage already done.
+    """
+    with repo.lock(exclusive=False):
+        repo.load_index()
+        selected = repo.select_snapshot(restore_as_of=restore_as_of,
+                                        previous=previous)
+        if selected is None:
+            return None
+        snap_id, manifest = selected
+        return TreeRestore(repo)._run_locked(snap_id, manifest, dest,
+                                             delete_extra=delete_extra)

@@ -16,7 +16,11 @@ from datetime import datetime, timedelta
 
 from volsync_tpu.engine import TreeBackup, restore_snapshot
 from volsync_tpu.objstore import open_store
-from volsync_tpu.repo.repository import RepoError, Repository
+from volsync_tpu.repo.repository import (
+    RepoError,
+    RepoLockedError,
+    Repository,
+)
 
 log = logging.getLogger("volsync_tpu.mover.restic")
 
@@ -43,10 +47,14 @@ def _open_or_init(env: dict) -> Repository:
     store = open_store(env["RESTIC_REPOSITORY"])
     password = env.get("RESTIC_PASSWORD") or None
     try:
-        return Repository.open(store, password=password)
+        repo = Repository.open(store, password=password)
     except RepoError:
         log.info("repository not initialized; creating (entry.sh:52-57)")
-        return Repository.init(store, password=password)
+        repo = Repository.init(store, password=password)
+    # Wait out a concurrent holder instead of failing the sync on first
+    # contention (shared repositories across CRs are supported).
+    repo.default_lock_wait = float(env.get("LOCK_WAIT_SECONDS", "120"))
+    return repo
 
 
 def _forget_kwargs(env: dict) -> dict:
@@ -62,6 +70,12 @@ def _forget_kwargs(env: dict) -> dict:
     return kw
 
 
+#: Mover exit code for "repository locked by another holder" — nonzero so
+#: the Job backoff machinery retries the sync (movers/common.py), distinct
+#: from the config errors (2) and no-matching-snapshot (3).
+RC_LOCKED = 4
+
+
 def restic_entrypoint(ctx) -> int:
     env = ctx.env
     direction = env.get("DIRECTION", "backup")
@@ -69,6 +83,17 @@ def restic_entrypoint(ctx) -> int:
         if required not in env:
             log.error("missing env %s (entry.sh:232-240)", required)
             return 2
+    try:
+        return _dispatch(ctx, env, direction)
+    except RepoLockedError as ex:
+        # Two CRs sharing one repository collide (shared backup vs
+        # exclusive forget/prune): fail this attempt cleanly and let the
+        # Job retry, don't crash the mover.
+        log.warning("repository locked, retrying later: %s", ex)
+        return RC_LOCKED
+
+
+def _dispatch(ctx, env: dict, direction: str) -> int:
     data = ctx.mounts["data"]
 
     if direction == "backup":
@@ -79,13 +104,20 @@ def restic_entrypoint(ctx) -> int:
         snap_id, stats = TreeBackup(repo).run(
             data, hostname=env.get("HOSTNAME", "volsync"))
         log.info("backup snapshot=%s stats=%s", snap_id, stats.as_dict())
-        kw = _forget_kwargs(env)
-        if kw:
-            removed = repo.forget(**kw)
-            log.info("forget removed %d snapshots", len(removed))
-        if env.get("PRUNE") == "1":
-            report = repo.prune()
-            log.info("prune: %s", report)
+        # Maintenance after a durable snapshot must not fail the sync: a
+        # lock collision here defers forget/prune to the next iteration
+        # instead of discarding the successful backup (a retry would
+        # duplicate the snapshot).
+        try:
+            kw = _forget_kwargs(env)
+            if kw:
+                removed = repo.forget(**kw)
+                log.info("forget removed %d snapshots", len(removed))
+            if env.get("PRUNE") == "1":
+                report = repo.prune()
+                log.info("prune: %s", report)
+        except RepoLockedError as ex:
+            log.warning("maintenance deferred (repository locked): %s", ex)
         return 0
 
     if direction == "prune":
@@ -96,6 +128,7 @@ def restic_entrypoint(ctx) -> int:
     if direction == "restore":
         repo = Repository.open(open_store(env["RESTIC_REPOSITORY"]),
                                password=env.get("RESTIC_PASSWORD") or None)
+        repo.default_lock_wait = float(env.get("LOCK_WAIT_SECONDS", "120"))
         as_of = (datetime.fromisoformat(env["RESTORE_AS_OF"])
                  if env.get("RESTORE_AS_OF") else None)
         previous = int(env.get("SELECT_PREVIOUS", "0"))

@@ -121,7 +121,8 @@ def gear_hash_positions(data: jax.Array, seed: int) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("seed", "max_candidates",
                                              "mask_s", "mask_l"))
 def cdc_candidates(data: jax.Array, *, seed: int,
-                   mask_s: int, mask_l: int, max_candidates: int):
+                   mask_s: int, mask_l: int, max_candidates: int,
+                   valid_len=None):
     """Compute compacted candidate cut positions on device.
 
     Returns (idx_s, count_s, idx_l, count_l): positions where
@@ -129,11 +130,19 @@ def cdc_candidates(data: jax.Array, *, seed: int,
     ``max_candidates`` indices in order plus the *true* total counts (host
     re-runs with a larger bound if truncated, keeping chunking
     deterministic).
+
+    ``valid_len`` (traced scalar) restricts candidates and counts to
+    positions < valid_len, so zero-padding a bucketed buffer can neither
+    add candidates nor inflate the counts the overflow retry keys on.
     """
     h = gear_hash_positions(data, seed)
     is_s = (h & np.uint32(mask_s)) == 0
     is_l = (h & np.uint32(mask_l)) == 0
     L = data.shape[0]
+    if valid_len is not None:
+        pos_ok = jnp.arange(L, dtype=jnp.int32) < valid_len
+        is_s = is_s & pos_ok
+        is_l = is_l & pos_ok
     idx_s = jnp.nonzero(is_s, size=max_candidates, fill_value=L)[0]
     idx_l = jnp.nonzero(is_l, size=max_candidates, fill_value=L)[0]
     return idx_s, jnp.sum(is_s), idx_l, jnp.sum(is_l)

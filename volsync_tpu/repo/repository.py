@@ -21,6 +21,7 @@ import hashlib
 import json
 import threading
 import time as time_mod
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from datetime import datetime, timedelta, timezone
 from typing import Iterable, Optional
@@ -39,6 +40,10 @@ _COMPRESS_MIN_GAIN = 0.9  # keep compressed form only if <= 90% of raw
 
 class RepoError(RuntimeError):
     pass
+
+
+class RepoLockedError(RepoError):
+    """Another process holds a conflicting repository lock."""
 
 
 def _parse_time(value: str) -> datetime:
@@ -136,9 +141,145 @@ class Repository:
     def chunker_params(self) -> dict:
         return dict(self.config["chunker"])
 
+    # -- locking ------------------------------------------------------------
+    #
+    # restic-style lock objects in the store (locks/<id>): writers take a
+    # shared lock, prune/forget take an exclusive lock, so a concurrent
+    # prune can never sweep a live backup's freshly written packs/index
+    # deltas. Create-then-check (restic's own protocol): write our lock
+    # object first, then scan for conflicts; back out on conflict. Locks
+    # older than LOCK_STALE_SECONDS are treated as crashed holders and
+    # removed; live holders refresh their lock's timestamp every
+    # LOCK_REFRESH_SECONDS (restic's ~5-minute refresh) so a long-running
+    # backup is never mistaken for a crash.
+
+    LOCK_STALE_SECONDS = 30 * 60
+    LOCK_REFRESH_SECONDS = 5 * 60
+
+    #: Default contention wait for lock() callers that don't pass one
+    #: (movers raise it so a shared/exclusive collision between two CRs
+    #: waits out the other side instead of failing the whole sync).
+    default_lock_wait: float = 0.0
+
+    def _write_lock(self, exclusive: bool) -> str:
+        import os
+        import socket
+
+        payload = json.dumps({
+            "exclusive": exclusive,
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "time": datetime.now(timezone.utc).isoformat(),
+        }).encode()
+        lock_id = hashlib.sha256(payload + os.urandom(16)).hexdigest()
+        self.store.put(f"locks/{lock_id}", payload)
+        return f"locks/{lock_id}"
+
+    def _conflicting_lock(self, own_key: str,
+                          exclusive: bool) -> Optional[str]:
+        now = datetime.now(timezone.utc)
+        for key in list(self.store.list("locks/")):
+            if key == own_key:
+                continue
+            try:
+                info = json.loads(self.store.get(key))
+            except (NoSuchKey, ValueError):
+                continue
+            try:
+                age = (now - _parse_time(info["time"])).total_seconds()
+            except (KeyError, ValueError):
+                age = self.LOCK_STALE_SECONDS + 1
+            if age > self.LOCK_STALE_SECONDS:
+                self.store.delete(key)  # crashed holder
+                continue
+            if exclusive or info.get("exclusive"):
+                return key
+        return None
+
+    @contextmanager
+    def lock(self, *, exclusive: bool = False,
+             wait_seconds: Optional[float] = None):
+        """Hold a repository lock for the duration of the with-block.
+
+        Raises RepoLockedError if a conflicting lock persists past
+        ``wait_seconds`` (default: ``self.default_lock_wait``).
+        """
+        if wait_seconds is None:
+            wait_seconds = self.default_lock_wait
+        own: Optional[str] = self._write_lock(exclusive)
+        stop = threading.Event()
+        refresher = None
+        try:
+            deadline = time_mod.monotonic() + wait_seconds
+            while True:
+                conflict = self._conflicting_lock(own, exclusive)
+                if conflict is None:
+                    break
+                # Back out before waiting (restic's protocol): keeping our
+                # lock in the store while polling would make two
+                # concurrent acquirers block each other forever.
+                self.store.delete(own)
+                own = None
+                if time_mod.monotonic() >= deadline:
+                    raise RepoLockedError(
+                        f"repository is locked by {conflict} "
+                        f"(wanted {'exclusive' if exclusive else 'shared'})")
+                # Randomized backoff: two contenders started in lock-step
+                # (same cron tick on two hosts) must desynchronize, or
+                # they re-collide every round until both time out.
+                import random
+
+                time_mod.sleep(
+                    min(1.0, max(wait_seconds, 0.1)) * random.uniform(0.2, 1.0))
+                own = self._write_lock(exclusive)
+
+            lock_key = own
+
+            def refresh():
+                while not stop.wait(self.LOCK_REFRESH_SECONDS):
+                    try:
+                        info = json.loads(self.store.get(lock_key))
+                        info["time"] = datetime.now(timezone.utc).isoformat()
+                        if stop.is_set():  # released while we were reading
+                            break
+                        self.store.put(lock_key, json.dumps(info).encode())
+                    except Exception:  # noqa: BLE001 — keep holding
+                        pass
+                # The refresher owns deletion: by the time we get here any
+                # in-flight refresh put has completed, so the delete cannot
+                # be resurrected behind our back (an orphaned fresh-looking
+                # lock would block exclusive ops for LOCK_STALE_SECONDS).
+                try:
+                    self.store.delete(lock_key)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            refresher = threading.Thread(target=refresh, daemon=True)
+            refresher.start()
+            yield
+        finally:
+            stop.set()
+            if refresher is not None:
+                # The refresher deletes the lock when it exits; the join
+                # just bounds how long release waits for that.
+                refresher.join(timeout=10.0)
+            elif own is not None:
+                try:
+                    self.store.delete(own)
+                except NoSuchKey:
+                    pass
+
     # -- index --------------------------------------------------------------
 
     def load_index(self):
+        """(Re)read index deltas from the store.
+
+        Entries for blobs this process has written but not yet persisted
+        to an index object — the open pack's buffer and _pending_index —
+        are preserved: a mid-lifecycle reload (backup/restore re-reading
+        after lock acquisition) must not wipe a concurrent local writer's
+        in-flight state.
+        """
         with self._lock:
             self._index.clear()
             for key in self.store.list("index/"):
@@ -151,6 +292,17 @@ class Repository:
                             pack=pack_id, type=e["type"], offset=e["offset"],
                             length=e["length"], raw_length=e["raw_length"],
                         )
+            for pack_id, entries in self._pending_index.items():
+                for e in entries:
+                    self._index.setdefault(e["id"], IndexEntry(
+                        pack=pack_id, type=e["type"], offset=e["offset"],
+                        length=e["length"], raw_length=e["raw_length"],
+                    ))
+            for e in self._cur_entries:
+                self._index.setdefault(e["id"], IndexEntry(
+                    pack="", type=e["type"], offset=e["offset"],
+                    length=e["length"], raw_length=e["raw_length"],
+                ))
 
     def has_blob(self, blob_id: str) -> bool:
         with self._lock:
@@ -307,17 +459,27 @@ class Repository:
         """Apply a restic-style retain policy; returns deleted snapshot ids
         (restic ``forget`` — the FORGET_OPTIONS the reference builds in
         controllers/mover/restic/mover.go:440-471)."""
+        with self.lock(exclusive=True):
+            return self._forget_locked(
+                last=last, hourly=hourly, daily=daily, weekly=weekly,
+                monthly=monthly, yearly=yearly, within=within)
+
+    def _forget_locked(self, *, last=None, hourly=None, daily=None,
+                       weekly=None, monthly=None, yearly=None,
+                       within=None) -> list[str]:
         snaps = self.list_snapshots()
         if not snaps:
             return []
         keep: set[str] = set()
-        newest_time = datetime.fromisoformat(snaps[-1][1]["time"])
+        # _parse_time throughout: a repository mixing naive and tz-aware
+        # snapshot times must not raise on aware-vs-naive comparison.
+        newest_time = _parse_time(snaps[-1][1]["time"])
         if last:
             keep.update(sid for sid, _ in snaps[-last:])
         if within:
             keep.update(
                 sid for sid, m in snaps
-                if datetime.fromisoformat(m["time"]) >= newest_time - within
+                if _parse_time(m["time"]) >= newest_time - within
             )
         buckets = (
             (hourly, "%Y-%m-%d-%H"), (daily, "%Y-%m-%d"),
@@ -328,7 +490,7 @@ class Repository:
                 continue
             seen: dict[str, str] = {}
             for sid, m in snaps:  # ascending: later overwrites keep newest
-                seen[datetime.fromisoformat(m["time"]).strftime(fmt)] = sid
+                seen[_parse_time(m["time"]).strftime(fmt)] = sid
             for bucket_key in sorted(seen, reverse=True)[:count]:
                 keep.add(seen[bucket_key])
         if not keep:  # a policy that keeps nothing keeps the newest
@@ -371,9 +533,10 @@ class Repository:
           4. sweep pack objects not referenced by the new index (this
              also collects orphans left by a crash in an earlier prune).
         A crash between any steps leaves a repository where every
-        snapshot still restores.
+        snapshot still restores. Takes an exclusive repository lock so a
+        concurrent backup's packs/index deltas are never swept.
         """
-        with self._lock:
+        with self.lock(exclusive=True), self._lock:
             self.flush()
             reachable = self.referenced_blobs()
             by_pack: dict[str, list[str]] = {}
