@@ -88,3 +88,28 @@ def test_default_params_are_restic_envelope():
     assert DEFAULT_PARAMS.min_size == 512 * 1024
     assert DEFAULT_PARAMS.avg_size == 1024 * 1024
     assert DEFAULT_PARAMS.max_size == 8 * 1024 * 1024
+
+
+def test_hash_spans_and_streaming_match_host_blobid(tmp_path, rng):
+    from volsync_tpu.engine.chunker import hash_file_streaming, hash_spans
+    from volsync_tpu.repo import blobid
+
+    blobs = [b"", b"x", rng.bytes(4096), rng.bytes(4097), rng.bytes(70_000)]
+    buf = b"".join(blobs)
+    spans = []
+    off = 0
+    for b in blobs:
+        spans.append((off, len(b)))
+        off += len(b)
+    got = hash_spans(buf, spans)
+    assert got == [blobid.blob_id(b) for b in blobs]
+
+    # streaming path: digest independent of segmentation
+    big = rng.bytes(3 * 1024 * 1024 + 123)
+    p = tmp_path / "big.bin"
+    p.write_bytes(big)
+    assert hash_file_streaming(p, segment_size=1024 * 1024) \
+        == blobid.blob_id(big)
+    empty = tmp_path / "empty"
+    empty.write_bytes(b"")
+    assert hash_file_streaming(empty) == blobid.blob_id(b"")

@@ -1,0 +1,237 @@
+"""End-to-end: rclone mover bucket mirroring, source -> destination.
+
+The in-process analogue of the reference's rclone e2e playbook
+(test-e2e/test_simple_rclone.yml): a ReplicationSource mirrors its
+volume into a bucket, a ReplicationDestination mirrors the bucket into
+a fresh volume, trees come out byte-identical — including the
+delete-extraneous mirror case and metadata (mode/mtime) round-trip.
+"""
+
+import os
+import pathlib
+
+import pytest
+
+from volsync_tpu.api.common import CopyMethod, ObjectMeta
+from volsync_tpu.api.types import (
+    ReplicationDestination,
+    ReplicationDestinationRcloneSpec,
+    ReplicationDestinationSpec,
+    ReplicationSource,
+    ReplicationSourceRcloneSpec,
+    ReplicationSourceSpec,
+    ReplicationTrigger,
+)
+from volsync_tpu.cluster.cluster import Cluster
+from volsync_tpu.cluster.objects import Secret, Volume, VolumeSpec
+from volsync_tpu.cluster.runner import EntrypointCatalog, JobRunner
+from volsync_tpu.cluster.storage import StorageProvider
+from volsync_tpu.controller.manager import Manager
+from volsync_tpu.metrics import Metrics
+from volsync_tpu.movers import rclone as rclone_mover
+from volsync_tpu.movers.base import Catalog
+
+
+@pytest.fixture
+def world(tmp_path):
+    cluster = Cluster(storage=StorageProvider(tmp_path / "storage"))
+    catalog = Catalog()
+    runner_catalog = EntrypointCatalog()
+    rclone_mover.register(catalog, runner_catalog)
+    runner = JobRunner(cluster, runner_catalog).start()
+    manager = Manager(cluster, catalog=catalog, metrics=Metrics()).start()
+    yield cluster, tmp_path
+    manager.stop()
+    runner.stop()
+
+
+def make_volume(cluster, name, files: dict, ns="default"):
+    vol = cluster.create(Volume(metadata=ObjectMeta(name=name, namespace=ns),
+                                spec=VolumeSpec(capacity=1 << 30)))
+    root = pathlib.Path(vol.status.path)
+    for rel, content in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(content)
+    return vol
+
+
+def rclone_secret(cluster, tmp_path, name="rclone-secret", ns="default"):
+    conf = f"[bucket]\nurl = file://{tmp_path / 'bucket'}\n"
+    return cluster.create(Secret(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        data={"rclone.conf": conf.encode()},
+    ))
+
+
+def wait(cluster, pred, timeout=30.0):
+    assert cluster.wait_for(pred, timeout=timeout, poll=0.05), "timed out"
+
+
+def _rclone_src_spec(**kw):
+    return ReplicationSourceRcloneSpec(
+        rclone_config_section="bucket", rclone_dest_path="pvc1",
+        rclone_config="rclone-secret", **kw)
+
+
+def _rclone_dst_spec(**kw):
+    return ReplicationDestinationRcloneSpec(
+        rclone_config_section="bucket", rclone_dest_path="pvc1",
+        rclone_config="rclone-secret", **kw)
+
+
+def _sync_source(cluster, tag, name="up"):
+    cr = cluster.try_get("ReplicationSource", "default", name)
+    if cr is None:
+        cr = ReplicationSource(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=ReplicationSourceSpec(
+                source_pvc="app-data",
+                trigger=ReplicationTrigger(manual=tag),
+                rclone=_rclone_src_spec(copy_method=CopyMethod.SNAPSHOT),
+            ),
+        )
+        cluster.create(cr)
+    else:
+        cr.spec.trigger = ReplicationTrigger(manual=tag)
+        cluster.update(cr)
+    wait(cluster, lambda: (
+        (c := cluster.try_get("ReplicationSource", "default", name))
+        and c.status and c.status.last_manual_sync == tag))
+
+
+def _sync_destination(cluster, tag, name="down"):
+    cr = cluster.try_get("ReplicationDestination", "default", name)
+    if cr is None:
+        cr = ReplicationDestination(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=ReplicationDestinationSpec(
+                trigger=ReplicationTrigger(manual=tag),
+                rclone=_rclone_dst_spec(copy_method=CopyMethod.SNAPSHOT),
+            ),
+        )
+        cluster.create(cr)
+    else:
+        cr.spec.trigger = ReplicationTrigger(manual=tag)
+        cluster.update(cr)
+    wait(cluster, lambda: (
+        (c := cluster.try_get("ReplicationDestination", "default", name))
+        and c.status and c.status.last_manual_sync == tag))
+    c = cluster.get("ReplicationDestination", "default", name)
+    snap = cluster.get("VolumeSnapshot", "default", c.status.latest_image.name)
+    return pathlib.Path(snap.status.bound_content)
+
+
+def test_bucket_mirror_roundtrip_and_delete_extraneous(world, rng):
+    cluster, tmp_path = world
+    files = {
+        "a.txt": b"alpha" * 2000,
+        "sub/deep/b.bin": rng.bytes(250_000),
+        "dup1.bin": b"same-bytes" * 1000,
+        "dup2.bin": b"same-bytes" * 1000,  # dedups to one object
+    }
+    vol = make_volume(cluster, "app-data", files)
+    src_root = pathlib.Path(vol.status.path)
+    (src_root / "emptydir").mkdir()  # --create-empty-src-dirs
+    os.symlink("a.txt", src_root / "link.txt")
+    os.chmod(src_root / "a.txt", 0o640)
+    rclone_secret(cluster, tmp_path)
+
+    _sync_source(cluster, "one")
+    restored = _sync_destination(cluster, "one")
+
+    for rel, content in files.items():
+        assert (restored / rel).read_bytes() == content
+    assert (restored / "emptydir").is_dir()
+    assert os.readlink(restored / "link.txt") == "a.txt"
+    assert (restored / "a.txt").stat().st_mode & 0o777 == 0o640
+    assert ((restored / "a.txt").stat().st_mtime_ns
+            == (src_root / "a.txt").stat().st_mtime_ns)
+
+    # content-addressed bucket: identical files share one object
+    bucket = tmp_path / "bucket" / "pvc1" / "objects"
+    n_objects = len(list(bucket.iterdir()))
+    assert n_objects == 3  # a.txt, b.bin, dup{1,2} share
+
+    # -- second iteration: delete a file + change one; mirror must follow
+    (src_root / "dup2.bin").unlink()
+    (src_root / "a.txt").write_bytes(b"changed")
+    _sync_source(cluster, "two")
+    restored2 = _sync_destination(cluster, "two")
+    assert not (restored2 / "dup2.bin").exists()
+    assert (restored2 / "a.txt").read_bytes() == b"changed"
+    assert (restored2 / "sub/deep/b.bin").read_bytes() == files["sub/deep/b.bin"]
+
+
+def test_destination_into_provided_pvc_syncs_in_place(world, rng):
+    """DIRECTION=destination into an existing PVC: extraneous local data
+    is removed, matching files are skipped (checksum compare)."""
+    cluster, tmp_path = world
+    files = {"keep.bin": rng.bytes(100_000), "new.txt": b"hello"}
+    make_volume(cluster, "app-data", files)
+    rclone_secret(cluster, tmp_path)
+    _sync_source(cluster, "one")
+
+    # destination PVC pre-populated with one matching + one extraneous file
+    dst = make_volume(cluster, "dest-pvc", {"keep.bin": files["keep.bin"],
+                                            "stale.txt": b"old"})
+    rd = ReplicationDestination(
+        metadata=ObjectMeta(name="inplace", namespace="default"),
+        spec=ReplicationDestinationSpec(
+            trigger=ReplicationTrigger(manual="go"),
+            rclone=_rclone_dst_spec(destination_pvc="dest-pvc",
+                                    copy_method=CopyMethod.DIRECT),
+        ),
+    )
+    cluster.create(rd)
+    wait(cluster, lambda: (
+        (c := cluster.try_get("ReplicationDestination", "default", "inplace"))
+        and c.status and c.status.last_manual_sync == "go"))
+    root = pathlib.Path(dst.status.path)
+    assert (root / "keep.bin").read_bytes() == files["keep.bin"]
+    assert (root / "new.txt").read_bytes() == b"hello"
+    assert not (root / "stale.txt").exists()
+
+
+def test_missing_config_section_fails_job(world, rng):
+    """A bad RCLONE_CONFIG_SECTION fails the mover Job (rc=1) and the CR
+    reports the failure instead of completing."""
+    cluster, tmp_path = world
+    make_volume(cluster, "app-data", {"x": b"y"})
+    rclone_secret(cluster, tmp_path)
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="bad", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="app-data",
+            trigger=ReplicationTrigger(manual="go"),
+            rclone=ReplicationSourceRcloneSpec(
+                rclone_config_section="nope", rclone_dest_path="p",
+                rclone_config="rclone-secret",
+                copy_method=CopyMethod.SNAPSHOT),
+        ),
+    )
+    cluster.create(rs)
+    # job retries then hits backoff; the sync never completes
+    wait(cluster, lambda: (
+        (j := cluster.try_get("Job", "default", "volsync-rclone-src-bad"))
+        and j.status.failed > 0))
+    cr = cluster.get("ReplicationSource", "default", "bad")
+    assert cr.status is None or cr.status.last_manual_sync != "go"
+
+
+def test_hostile_index_paths_rejected(tmp_path):
+    """A crafted index must not write outside the volume root."""
+    import json
+
+    from volsync_tpu.movers.rclone.sync import SyncError, sync_down
+    from volsync_tpu.objstore import FsObjectStore
+
+    store = FsObjectStore(tmp_path / "bucket")
+    store.put("p/index.json", json.dumps({"version": 1, "entries": {
+        "../escape.txt": {"type": "file", "size": 1, "mode": 0o644,
+                          "mtime_ns": 0, "digest": "d" * 64},
+    }}).encode())
+    dst = tmp_path / "dst"
+    with pytest.raises(SyncError, match="unsafe"):
+        sync_down(store, "p", dst)
+    assert not (tmp_path / "escape.txt").exists()

@@ -109,41 +109,99 @@ class DeviceChunkHasher:
         return [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)]
 
     def _hash_chunks(self, dev, chunks: list[tuple[int, int]]) -> list[str]:
-        """Merkle blob ids for (start, length) slices of the device buffer
-        (repo/blobid.py): every 4 KiB leaf of every chunk hashes as one
-        independent lane — wide batch, 65-step scan, a single compiled
-        shape — then the tiny roots combine host-side."""
-        import jax.numpy as jnp
+        return device_span_roots(dev, chunks)
 
-        leaf_starts: list[int] = []
-        leaf_lengths: list[int] = []
-        spans: list[tuple[int, int]] = []  # (first leaf index, count) per chunk
-        for start, length in chunks:
-            first = len(leaf_starts)
-            n = blobid.leaf_count(length)
-            for k in range(n):
-                off = k * blobid.LEAF_SIZE
-                leaf_starts.append(start + off)
-                leaf_lengths.append(min(blobid.LEAF_SIZE, length - off))
-            spans.append((first, n))
-        lanes = _pow2ceil(len(leaf_starts), 128)
-        starts = np.zeros((lanes,), np.int32)
-        lengths = np.zeros((lanes,), np.int32)
-        starts[: len(leaf_starts)] = leaf_starts
-        lengths[: len(leaf_lengths)] = leaf_lengths
-        digests = np.asarray(sha256_chunks_device(
-            dev, jnp.asarray(starts), jnp.asarray(lengths),
-            max_len=blobid.LEAF_SIZE,
-        )).astype(">u4")
-        leaf_bytes = digests.tobytes()  # 32 bytes per lane, row-major
-        out = []
-        for (first, n), (_, length) in zip(spans, chunks):
-            out.append(blobid.root_from_leaves(
-                length,
-                [leaf_bytes[32 * (first + k) : 32 * (first + k + 1)]
-                 for k in range(n)],
-            ))
-        return out
+
+def device_leaf_digests(dev, leaf_starts: list[int],
+                        leaf_lengths: list[int]) -> list[bytes]:
+    """SHA-256 digests of arbitrary <=4 KiB slices of a device buffer,
+    every slice an independent lane (wide batch, 65-step scan, a single
+    compiled shape per lane-count bucket)."""
+    import jax.numpy as jnp
+
+    lanes = _pow2ceil(len(leaf_starts), 128)
+    starts = np.zeros((lanes,), np.int32)
+    lengths = np.zeros((lanes,), np.int32)
+    starts[: len(leaf_starts)] = leaf_starts
+    lengths[: len(leaf_lengths)] = leaf_lengths
+    digests = np.asarray(sha256_chunks_device(
+        dev, jnp.asarray(starts), jnp.asarray(lengths),
+        max_len=blobid.LEAF_SIZE,
+    )).astype(">u4")
+    leaf_bytes = digests.tobytes()  # 32 bytes per lane, row-major
+    return [leaf_bytes[32 * k : 32 * (k + 1)]
+            for k in range(len(leaf_starts))]
+
+
+def device_span_roots(dev, chunks: list[tuple[int, int]]) -> list[str]:
+    """Merkle blob ids for (start, length) slices of the device buffer
+    (repo/blobid.py): every 4 KiB leaf of every chunk hashes as one
+    independent lane, then the tiny roots combine host-side."""
+    leaf_starts: list[int] = []
+    leaf_lengths: list[int] = []
+    spans: list[tuple[int, int]] = []  # (first leaf index, count) per chunk
+    for start, length in chunks:
+        first = len(leaf_starts)
+        n = blobid.leaf_count(length)
+        for k in range(n):
+            off = k * blobid.LEAF_SIZE
+            leaf_starts.append(start + off)
+            leaf_lengths.append(min(blobid.LEAF_SIZE, length - off))
+        spans.append((first, n))
+    leaves = device_leaf_digests(dev, leaf_starts, leaf_lengths)
+    return [
+        blobid.root_from_leaves(length, leaves[first : first + n])
+        for (first, n), (_, length) in zip(spans, chunks)
+    ]
+
+
+def _upload_padded(buffer):
+    """Host bytes/array -> device array padded to a bucketed length."""
+    import jax.numpy as jnp
+
+    if isinstance(buffer, (bytes, bytearray, memoryview)):
+        buffer = np.frombuffer(buffer, dtype=np.uint8)
+    length = int(buffer.shape[0])
+    padded = _buffer_bucket(max(length, 1))
+    if padded != length:
+        buffer = np.pad(buffer, (0, padded - length))
+    return jnp.asarray(buffer)
+
+
+def hash_spans(buffer, spans: list[tuple[int, int]]) -> list[str]:
+    """Device-batched blob ids for (start, length) spans of one buffer.
+
+    The checksum-compare primitive for the rclone-style mover (the
+    reference's `rclone sync --checksum`, mover-rclone/active.sh:19):
+    many files are packed into one host buffer, uploaded once, and every
+    4 KiB leaf of every span hashes as an independent lane.
+    """
+    if not spans:
+        return []
+    return device_span_roots(_upload_padded(buffer), spans)
+
+
+def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
+    """Blob id of an arbitrarily large file with bounded memory: leaf
+    digests are computed on device one ~32 MiB segment at a time and the
+    root combines host-side (repo/blobid.py)."""
+    assert segment_size % blobid.LEAF_SIZE == 0
+    leaves: list[bytes] = []
+    total = 0
+    with open(path, "rb") as f:
+        while True:
+            seg = f.read(segment_size)
+            if not seg:
+                break
+            total += len(seg)
+            dev = _upload_padded(seg)
+            n = blobid.leaf_count(len(seg))
+            starts = [k * blobid.LEAF_SIZE for k in range(n)]
+            lengths = [min(blobid.LEAF_SIZE, len(seg) - s) for s in starts]
+            leaves.extend(device_leaf_digests(dev, starts, lengths))
+    if total == 0:
+        return blobid.blob_id(b"")
+    return blobid.root_from_leaves(total, leaves)
 
 
 def stream_chunks(reader: Callable[[int], bytes], params: GearParams,

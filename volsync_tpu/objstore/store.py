@@ -24,6 +24,32 @@ class ObjectStore(Protocol):
     def size(self, key: str) -> int: ...
 
 
+def put_file(store, key: str, src) -> None:
+    """Upload a local file as one object with bounded memory when the
+    store supports it (multipart-upload analogue); whole-bytes fallback
+    otherwise."""
+    fn = getattr(store, "put_file", None)
+    if fn is not None:
+        fn(key, src)
+    else:
+        store.put(key, Path(src).read_bytes())
+
+
+def get_file(store, key: str, dst) -> int:
+    """Download an object into a local file with bounded memory when the
+    store supports it; returns bytes written. The write is atomic
+    (temp + rename) so a crashed transfer never leaves a torn file."""
+    fn = getattr(store, "get_file", None)
+    if fn is not None:
+        return fn(key, dst)
+    data = store.get(key)
+    dst = Path(dst)
+    tmp = dst.parent / f".volsync.tmp.{os.getpid()}.{dst.name}"
+    tmp.write_bytes(data)
+    tmp.replace(dst)
+    return len(data)
+
+
 class NoSuchKey(KeyError):
     pass
 
@@ -93,6 +119,28 @@ class FsObjectStore:
             return self._path(key).stat().st_size
         except FileNotFoundError:
             raise NoSuchKey(key) from None
+
+    def put_file(self, key: str, src) -> None:
+        import shutil
+
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
+        shutil.copyfile(src, tmp)
+        tmp.rename(p)
+
+    def get_file(self, key: str, dst) -> int:
+        import shutil
+
+        dst = Path(dst)
+        tmp = dst.parent / f".volsync.tmp.{os.getpid()}.{dst.name}"
+        try:
+            shutil.copyfile(self._path(key), tmp)
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+        n = tmp.stat().st_size
+        tmp.replace(dst)
+        return n
 
 
 class MemObjectStore:

@@ -366,7 +366,18 @@ class Repository:
         pack_id = hashlib.sha256(blob).hexdigest()
         self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
         for e in self._cur_entries:
-            self._index[e["id"]].pack = pack_id
+            cur = self._index.get(e["id"])
+            if cur is not None and cur.pack == "":
+                cur.pack = pack_id
+            elif cur is None:
+                # a load_index between buffering and flush dropped the
+                # entry (shouldn't happen — preservation keeps buffered
+                # ids — but re-adding is always safe)
+                self._index[e["id"]] = IndexEntry(
+                    pack=pack_id, type=e["type"], offset=e["offset"],
+                    length=e["length"], raw_length=e["raw_length"])
+            # else: rebound to a store-sourced pack by load_index — its
+            # offset/length belong to that pack; leave it pointing there
         self._pending_index[pack_id] = self._cur_entries
         self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
 
