@@ -91,6 +91,13 @@ class JobStatus:
     message: Optional[str] = None
     start_time: Optional[datetime] = None
     completion_time: Optional[datetime] = None
+    node: Optional[str] = None  # where the payload ran (pod.spec.nodeName)
+    # Data-plane self-report (the pod termination-message analogue): how
+    # many bytes the transfer moved and how long the data path took. The
+    # control plane turns this into the throughput gauge
+    # (volsync_data_throughput_bytes_per_second).
+    transfer_bytes: Optional[int] = None
+    transfer_seconds: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -161,6 +168,9 @@ class DeploymentSpec:
 class DeploymentStatus:
     ready_replicas: int = 0
     message: Optional[str] = None
+    node: Optional[str] = None
+    transfer_bytes: Optional[int] = None
+    transfer_seconds: Optional[float] = None
 
 
 @dataclasses.dataclass

@@ -42,6 +42,21 @@ class JobContext:
     stop_event: threading.Event
     cluster: object = None
     attempt: int = 0
+    kind: str = "Job"       # Job | Deployment — which object hosts us
+
+    def report_transfer(self, nbytes: int, seconds: float):
+        """Data-plane self-report (the termination-message analogue): the
+        entrypoint records how many bytes its transfer moved and how long
+        the data path took; the control plane reads this off the completed
+        Job and drives the throughput gauge + TransferCompleted event."""
+        if self.cluster is None:
+            return
+        obj = self.cluster.try_get(self.kind, self.namespace, self.name)
+        if obj is None:
+            return
+        obj.status.transfer_bytes = int(nbytes)
+        obj.status.transfer_seconds = float(seconds)
+        self.cluster.update_status(obj)
 
 
 class EntrypointCatalog:
@@ -251,7 +266,7 @@ class JobRunner:
             ctx = JobContext(
                 name=dep.metadata.name, namespace=dep.metadata.namespace,
                 env=dict(dep.spec.env), mounts=mounts, secrets=secrets,
-                stop_event=stop, cluster=self.cluster,
+                stop_event=stop, cluster=self.cluster, kind="Deployment",
             )
             fn = self.catalog.get(dep.spec.entrypoint)
             try:

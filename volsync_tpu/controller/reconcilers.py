@@ -194,7 +194,9 @@ class ReplicationSourceReconciler(_ReconcilerBase):
 
     def _build_machine(self, cr):
         mover = self.catalog.source_mover(self.cluster, cr)
-        return RSMachine(cr, mover, self._bound_metrics(cr, mover))
+        bm = self._bound_metrics(cr, mover)
+        mover.metrics = bm  # movers feed the throughput gauge on completion
+        return RSMachine(cr, mover, bm)
 
 
 class ReplicationDestinationReconciler(_ReconcilerBase):
@@ -206,5 +208,6 @@ class ReplicationDestinationReconciler(_ReconcilerBase):
     def _build_machine(self, cr):
         utils.relinquish_do_not_delete_snapshots(self.cluster, cr)
         mover = self.catalog.destination_mover(self.cluster, cr)
-        return RDMachine(cr, mover, self._bound_metrics(cr, mover),
-                         self.cluster)
+        bm = self._bound_metrics(cr, mover)
+        mover.metrics = bm
+        return RDMachine(cr, mover, bm, self.cluster)

@@ -113,7 +113,7 @@ class VolumeHandler:
             ),
         )
         self._claim(vol, is_temporary)
-        vol = self.cluster.apply(vol)
+        vol = self._apply_with_event(vol, mover_base.EV_PVC_CREATED)
         if vol.status.phase != "Bound":
             self.cluster.record_event(
                 self.owner, "Warning", mover_base.EV_PVC_NOT_BOUND,
@@ -128,6 +128,20 @@ class VolumeHandler:
         utils.set_owned_by(obj, self.owner, self.cluster)
         if is_temporary:
             utils.mark_for_cleanup(obj, self.owner)
+
+    def _apply_with_event(self, obj, created_reason: str):
+        """apply() + emit the created event only on first creation
+        (the reference's recorder fires from ensure* creation sites —
+        volumehandler.go:192-205, mover/events.go:25-57)."""
+        existed = self.cluster.try_get(
+            obj.kind, obj.metadata.namespace, obj.metadata.name) is not None
+        out = self.cluster.apply(obj)
+        if not existed:
+            self.cluster.record_event(
+                self.owner, "Normal", created_reason,
+                f"{obj.kind.lower()} {obj.metadata.name} created",
+                mover_base.ACT_CREATING)
+        return out
 
     def _capacity_for(self, src: Volume,
                       snap: Optional[VolumeSnapshot] = None) -> Optional[int]:
@@ -152,7 +166,7 @@ class VolumeHandler:
             ),
         )
         self._claim(vol, is_temporary)
-        vol = self.cluster.apply(vol)
+        vol = self._apply_with_event(vol, mover_base.EV_PVC_CREATED)
         return vol if vol.status.phase == "Bound" else None
 
     def _ensure_snapshot(self, src: Volume, name: str,
@@ -170,7 +184,7 @@ class VolumeHandler:
             ),
         )
         self._claim(snap, is_temporary)
-        return self.cluster.apply(snap)
+        return self._apply_with_event(snap, mover_base.EV_SNAP_CREATED)
 
     def _ensure_volume_from_snapshot(self, src: Volume, snap: VolumeSnapshot,
                                      name: str,
@@ -188,5 +202,5 @@ class VolumeHandler:
             ),
         )
         self._claim(vol, is_temporary)
-        vol = self.cluster.apply(vol)
+        vol = self._apply_with_event(vol, mover_base.EV_PVC_CREATED)
         return vol if vol.status.phase == "Bound" else None

@@ -77,4 +77,65 @@ class BoundMetrics:
     throughput: object
 
 
+class MetricsServer:
+    """HTTP exposition + probes, the analogue of the reference manager's
+    metrics listener on :8080 and healthz/readyz probes on :8081
+    (controllers/metrics.go:82-85, main.go:140-153). One server carries
+    all three endpoints; ``port=0`` binds an ephemeral port (tests)."""
+
+    def __init__(self, metrics: "Metrics", host: str = "127.0.0.1",
+                 port: int = 8080,
+                 ready_check=None):
+        import http.server
+        import threading
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/metrics":
+                    body = outer.metrics.expose()
+                    ctype = "text/plain; version=0.0.4"
+                    code = 200
+                elif self.path == "/healthz":
+                    body, ctype, code = b"ok", "text/plain", 200
+                elif self.path == "/readyz":
+                    ok = outer.ready_check is None or outer.ready_check()
+                    body = b"ok" if ok else b"not ready"
+                    ctype, code = "text/plain", (200 if ok else 503)
+                else:
+                    body, ctype, code = b"not found", "text/plain", 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self.metrics = metrics
+        self.ready_check = ready_check
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-server")
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
 GLOBAL = Metrics()

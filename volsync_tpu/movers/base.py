@@ -43,7 +43,8 @@ class Result:
 __all__ = [
     "Mover", "Builder", "Catalog", "CATALOG", "Result",
     "NoMoverFound", "MultipleMoversFound",
-    "EV_TRANSFER_STARTED", "EV_TRANSFER_FAILED", "EV_PVC_CREATED",
+    "EV_TRANSFER_STARTED", "EV_TRANSFER_FAILED", "EV_TRANSFER_COMPLETED",
+    "EV_PVC_CREATED",
     "EV_PVC_NOT_BOUND", "EV_SNAP_CREATED", "EV_SNAP_NOT_BOUND",
     "EV_SVC_ADDRESS_ASSIGNED", "EV_SVC_NO_ADDRESS",
     "ACT_CREATING", "ACT_WAITING",
@@ -124,6 +125,9 @@ CATALOG = Catalog()
 # Event vocabulary (controllers/mover/events.go:25-57)
 EV_TRANSFER_STARTED = "TransferStarted"
 EV_TRANSFER_FAILED = "TransferFailed"
+# TPU addition: the reference never observes a transfer's data rate; the
+# device pipeline reports one, so completion gets its own event carrying it.
+EV_TRANSFER_COMPLETED = "TransferCompleted"
 EV_PVC_CREATED = "PersistentVolumeClaimCreated"
 EV_PVC_NOT_BOUND = "PersistentVolumeClaimNotBound"
 EV_SNAP_CREATED = "VolumeSnapshotCreated"

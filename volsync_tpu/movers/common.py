@@ -13,18 +13,46 @@ from typing import Optional
 from volsync_tpu.api.common import ObjectMeta
 from volsync_tpu.cluster.objects import Job, JobSpec
 from volsync_tpu.controller import utils
+from volsync_tpu.movers import base
 from volsync_tpu.movers.base import Result
+
+#: Annotation stamped on a completed Job once its transfer report has been
+#: turned into metrics + event, so re-reconciles don't double-count.
+TRANSFER_RECORDED_ANNOTATION = "volsync.backube/transfer-recorded"
 
 
 def mover_name(prefix: str, owner) -> str:
     return f"volsync-{prefix}-{owner.metadata.name}"
 
 
+def publish_transfer(cluster, owner, job, metrics=None):
+    """On Job completion: fold the data plane's transfer self-report
+    (JobStatus.transfer_*) into the throughput gauge and emit the
+    completion event, exactly once per Job incarnation."""
+    if job.metadata.annotations.get(TRANSFER_RECORDED_ANNOTATION):
+        return
+    nbytes, secs = job.status.transfer_bytes, job.status.transfer_seconds
+    if nbytes is not None and secs:
+        rate = nbytes / secs
+        if metrics is not None:
+            metrics.throughput.set(rate)
+        cluster.record_event(
+            owner, "Normal", base.EV_TRANSFER_COMPLETED,
+            f"transfer completed: {nbytes} bytes in {secs:.3f}s "
+            f"({rate / (1 << 20):.1f} MiB/s)")
+    else:
+        cluster.record_event(owner, "Normal", base.EV_TRANSFER_COMPLETED,
+                             "transfer completed")
+    job.metadata.annotations[TRANSFER_RECORDED_ANNOTATION] = "1"
+    cluster.update(job)
+
+
 def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
                   volumes: dict, secrets: Optional[dict] = None,
                   backoff_limit: int = 2, paused: bool = False,
                   service_account: Optional[str] = None,
-                  node_selector: Optional[dict] = None) -> Optional[Job]:
+                  node_selector: Optional[dict] = None,
+                  metrics=None) -> Optional[Job]:
     """Ensure the mover Job exists with the desired payload; return it
     once it has succeeded, None while still in progress.
 
@@ -40,6 +68,8 @@ def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
         cluster.delete("Job", owner.metadata.namespace, name)
         existing = None
     if existing is not None:
+        if existing.status.succeeded > 0:
+            publish_transfer(cluster, owner, existing, metrics)
         # The Job template is treated as immutable once created (k8s Job
         # semantics): only pause/unpause is applied. In particular the env
         # that RAN is preserved, so callers reading job.spec.env after
@@ -65,6 +95,8 @@ def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
     utils.set_owned_by(job, owner, cluster)
     utils.mark_for_cleanup(job, owner)
     job = cluster.create(job)
+    cluster.record_event(owner, "Normal", base.EV_TRANSFER_STARTED,
+                         f"mover job {name} created", base.ACT_CREATING)
     return job if job.status.succeeded > 0 else None
 
 

@@ -50,6 +50,7 @@ class RcloneSourceMover:
     owner: object
     spec: object  # ReplicationSourceRcloneSpec
     paused: bool = False
+    metrics: object = None
 
     name = MOVER_NAME
 
@@ -80,6 +81,7 @@ class RcloneSourceMover:
             secrets={SECRET_MOUNT: secret.metadata.name},
             backoff_limit=2,  # rclone/mover.go:225
             paused=self.paused, service_account=sa.metadata.name,
+            metrics=self.metrics,
         )
         if job is None:
             return Result.in_progress()
@@ -97,6 +99,7 @@ class RcloneDestinationMover:
     owner: object
     spec: object  # ReplicationDestinationRcloneSpec
     paused: bool = False
+    metrics: object = None
 
     name = MOVER_NAME
 
@@ -132,7 +135,7 @@ class RcloneDestinationMover:
             volumes={"data": dest.metadata.name},
             secrets={SECRET_MOUNT: secret.metadata.name},
             backoff_limit=2, paused=self.paused,
-            service_account=sa.metadata.name,
+            service_account=sa.metadata.name, metrics=self.metrics,
         )
         if job is None:
             return Result.in_progress()

@@ -72,5 +72,7 @@ def rclone_entrypoint(ctx) -> int:
     except SyncError as ex:
         log.error("sync failed: %s", ex)
         return 1
-    log.info("rclone completed in %.1fs %s", time.perf_counter() - t0, stats)
+    dt = time.perf_counter() - t0
+    log.info("rclone completed in %.1fs %s", dt, stats)
+    ctx.report_transfer(stats.get("bytes", 0), dt)
     return 0

@@ -192,7 +192,9 @@ def sync_up(root: Path, store: ObjectStore, prefix: str, *,
             store.delete(key)
             deleted += 1
     return {"files": len(files), "uploaded": uploaded,
-            "deduped": len(files) - uploaded, "deleted_objects": deleted}
+            "deduped": len(files) - uploaded, "deleted_objects": deleted,
+            "bytes": sum(e["size"] for e in entries.values()
+                         if e["type"] == "file")}
 
 
 def sync_down(store: ObjectStore, prefix: str, root: Path, *,
@@ -300,4 +302,6 @@ def sync_down(store: ObjectStore, prefix: str, root: Path, *,
         os.chmod(root / rel, entry["mode"])
         os.utime(root / rel, ns=(entry["mtime_ns"], entry["mtime_ns"]))
     return {"files": sum(1 for e in entries.values() if e["type"] == "file"),
-            "fetched": fetched, "skipped": skipped, "deleted_local": deleted}
+            "fetched": fetched, "skipped": skipped, "deleted_local": deleted,
+            "bytes": sum(e.get("size", 0) for e in entries.values()
+                         if e["type"] == "file")}

@@ -56,6 +56,7 @@ class ResticSourceMover:
     owner: object
     spec: object  # ReplicationSourceResticSpec
     paused: bool = False
+    metrics: object = None  # BoundMetrics, attached by the reconciler
 
     name = MOVER_NAME
 
@@ -86,6 +87,7 @@ class ResticSourceMover:
                      "cache": cache.metadata.name},
             backoff_limit=8,  # restic/mover.go:286
             paused=self.paused, service_account=sa.metadata.name,
+            metrics=self.metrics,
         )
         if job is None:
             return Result.in_progress()
@@ -131,6 +133,7 @@ class ResticDestinationMover:
     owner: object
     spec: object  # ReplicationDestinationResticSpec
     paused: bool = False
+    metrics: object = None
 
     name = MOVER_NAME
 
@@ -167,7 +170,7 @@ class ResticDestinationMover:
             volumes={"data": dest.metadata.name,
                      "cache": cache.metadata.name},
             backoff_limit=8, paused=self.paused,
-            service_account=sa.metadata.name,
+            service_account=sa.metadata.name, metrics=self.metrics,
         )
         if job is None:
             return Result.in_progress()

@@ -12,6 +12,7 @@ the reference's process boundary.
 from __future__ import annotations
 
 import logging
+import time
 from datetime import datetime, timedelta
 
 from volsync_tpu.engine import TreeBackup, restore_snapshot
@@ -101,9 +102,11 @@ def _dispatch(ctx, env: dict, direction: str) -> int:
             log.info("source is empty, skipping backup (entry.sh:44-50)")
             return 0
         repo = _open_or_init(env)
+        t0 = time.perf_counter()
         snap_id, stats = TreeBackup(repo).run(
             data, hostname=env.get("HOSTNAME", "volsync"))
         log.info("backup snapshot=%s stats=%s", snap_id, stats.as_dict())
+        ctx.report_transfer(stats.bytes_scanned, time.perf_counter() - t0)
         # Maintenance after a durable snapshot must not fail the sync: a
         # lock collision here defers forget/prune to the next iteration
         # instead of discarding the successful backup (a retry would
@@ -132,12 +135,14 @@ def _dispatch(ctx, env: dict, direction: str) -> int:
         as_of = (datetime.fromisoformat(env["RESTORE_AS_OF"])
                  if env.get("RESTORE_AS_OF") else None)
         previous = int(env.get("SELECT_PREVIOUS", "0"))
+        t0 = time.perf_counter()
         out = restore_snapshot(repo, data, restore_as_of=as_of,
                                previous=previous)
         if out is None:
             log.error("no snapshot matches the restore selectors")
             return 3
         log.info("restore: %s", out)
+        ctx.report_transfer(out.get("bytes", 0), time.perf_counter() - t0)
         return 0
 
     log.error("unknown DIRECTION %r", direction)

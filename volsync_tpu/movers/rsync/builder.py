@@ -37,6 +37,7 @@ class RsyncDestinationMover:
     owner: object
     spec: object  # ReplicationDestinationRsyncSpec
     paused: bool = False
+    metrics: object = None
 
     name = MOVER_NAME
 
@@ -65,7 +66,7 @@ class RsyncDestinationMover:
             env={"SERVICE": svc.metadata.name},
             volumes={"data": dest.metadata.name},
             secrets={"keys": secret.metadata.name},
-            backoff_limit=2, paused=self.paused,
+            backoff_limit=2, paused=self.paused, metrics=self.metrics,
         )
         # Publish the address once the listener has bound its port
         # (ensureServiceAndPublishAddress blocks on this —
@@ -132,6 +133,7 @@ class RsyncSourceMover:
     owner: object
     spec: object  # ReplicationSourceRsyncSpec
     paused: bool = False
+    metrics: object = None
 
     name = MOVER_NAME
 
@@ -167,7 +169,7 @@ class RsyncSourceMover:
             volumes={"data": data_vol.metadata.name},
             secrets={"keys": self.spec.ssh_keys},
             backoff_limit=2, paused=self.paused,
-            service_account=sa.metadata.name,
+            service_account=sa.metadata.name, metrics=self.metrics,
         )
         if job is None:
             return Result.in_progress()

@@ -162,10 +162,13 @@ def rsync_source_entrypoint(ctx) -> int:
         try:
             ch = channel.client_connect(address, port, key)
             try:
+                t0 = time.perf_counter()
                 stats = _push_tree(ch, root)
                 ch.send({"verb": "shutdown", "rc": 0})
                 ch.recv()
                 log.info("rsync push complete: %s", stats)
+                ctx.report_transfer(stats.get("bytes", 0),
+                                    time.perf_counter() - t0)
                 return 0
             finally:
                 ch.close()
@@ -182,7 +185,7 @@ def rsync_source_entrypoint(ctx) -> int:
 
 
 def _push_tree(ch, root: Path) -> dict:
-    stats = {"files": 0, "literal_bytes": 0, "copied_bytes": 0}
+    stats = {"files": 0, "literal_bytes": 0, "copied_bytes": 0, "bytes": 0}
     keep: list[str] = []
     for dirpath, dirs, files in os.walk(root):
         dirs.sort()
@@ -226,6 +229,7 @@ def _push_file(ch, path: Path, rel: str, st, stats: dict):
         raise channel.ChannelError(f"apply failed for {rel}: {out}")
     d = deltasync.delta_stats(ops, block_len)
     stats["files"] += 1
+    stats["bytes"] += len(data)
     stats["literal_bytes"] += d["literal_bytes"]
     stats["copied_bytes"] += d["copied_bytes"]
 
