@@ -1,0 +1,230 @@
+"""External-mover handoff, per-CR RBAC triple, and node affinity.
+
+Covers the reference behaviors: spec.external is "not ours — leave it
+alone" (replicationsource_controller.go:103-117), the per-CR
+SA+Role+RoleBinding identity (utils/sahandler.go:38-153), and the
+RWO/Direct node pinning (utils/affinity.go:35-83,
+docs/design/rwo-affinity.rst) — two JobRunners model a two-node cluster.
+"""
+
+import pytest
+
+from volsync_tpu.api.common import CopyMethod, ObjectMeta
+from volsync_tpu.api.types import (
+    ReplicationSource,
+    ReplicationSourceExternalSpec,
+    ReplicationSourceResticSpec,
+    ReplicationSourceSpec,
+    ReplicationTrigger,
+)
+from volsync_tpu.cluster.cluster import Cluster
+from volsync_tpu.cluster.objects import (
+    Deployment,
+    DeploymentSpec,
+    Secret,
+    Volume,
+    VolumeSpec,
+)
+from volsync_tpu.cluster.runner import EntrypointCatalog, JobRunner
+from volsync_tpu.cluster.storage import StorageProvider
+from volsync_tpu.controller.manager import Manager
+from volsync_tpu.metrics import Metrics
+from volsync_tpu.movers import restic as restic_mover
+from volsync_tpu.movers.base import Catalog
+
+
+@pytest.fixture
+def world(tmp_path):
+    """Two-node cluster: runner-a (node-a) + runner-b (node-b)."""
+    cluster = Cluster(storage=StorageProvider(tmp_path / "storage"))
+    catalog = Catalog()
+    runner_catalog = EntrypointCatalog()
+    restic_mover.register(catalog, runner_catalog)
+
+    @runner_catalog.register("app")
+    def app_entry(ctx):
+        ctx.stop_event.wait()  # a long-running app holding its volume
+        return 0
+
+    runner_a = JobRunner(cluster, runner_catalog, node_name="node-a").start()
+    runner_b = JobRunner(cluster, runner_catalog, node_name="node-b").start()
+    manager = Manager(cluster, catalog=catalog, metrics=Metrics()).start()
+    yield cluster, tmp_path
+    manager.stop()
+    runner_a.stop()
+    runner_b.stop()
+
+
+def wait(cluster, pred, timeout=30.0):
+    assert cluster.wait_for(pred, timeout=timeout, poll=0.05), "timed out"
+
+
+def _volume(cluster, name, modes=("ReadWriteOnce",)):
+    return cluster.create(Volume(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=VolumeSpec(capacity=1 << 30, access_modes=list(modes))))
+
+
+def test_external_spec_is_left_alone(world):
+    cluster, _ = world
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="ext", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="whatever",
+            external=ReplicationSourceExternalSpec(provisioner="acme.io/mover"),
+        ),
+    )
+    cluster.create(rs)
+    # Give the manager a few passes: the CR must stay untouched — no
+    # Error condition, no status scribbles (the external provisioner owns it).
+    import time
+
+    time.sleep(1.0)
+    cr = cluster.get("ReplicationSource", "default", "ext")
+    assert not cr.status or not any(
+        c.reason == "Error" for c in cr.status.conditions)
+
+
+def test_external_plus_internal_is_config_error(world, tmp_path):
+    cluster, _ = world
+    _volume(cluster, "v0")
+    cluster.create(Secret(
+        metadata=ObjectMeta(name="sec0", namespace="default"),
+        data={"RESTIC_REPOSITORY": str(tmp_path / "r0").encode(),
+              "RESTIC_PASSWORD": b"x"}))
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="both", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="v0",
+            external=ReplicationSourceExternalSpec(provisioner="acme.io/mover"),
+            restic=ReplicationSourceResticSpec(repository="sec0"),
+        ),
+    )
+    cluster.create(rs)
+    wait(cluster, lambda: (
+        (cr := cluster.try_get("ReplicationSource", "default", "both"))
+        and cr.status and any(
+            c.reason == "Error" and "external" in c.message
+            for c in cr.status.conditions)))
+
+
+def test_rbac_triple_created_per_cr(world, tmp_path, rng):
+    cluster, _ = world
+    vol = _volume(cluster, "data-r")
+    import pathlib
+
+    pathlib.Path(vol.status.path, "f").write_bytes(rng.bytes(1000))
+    cluster.create(Secret(
+        metadata=ObjectMeta(name="sec-r", namespace="default"),
+        data={"RESTIC_REPOSITORY": str(tmp_path / "r1").encode(),
+              "RESTIC_PASSWORD": b"x"}))
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="rb", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="data-r", trigger=ReplicationTrigger(manual="go"),
+            restic=ReplicationSourceResticSpec(
+                repository="sec-r", copy_method=CopyMethod.CLONE)),
+    )
+    cluster.create(rs)
+    wait(cluster, lambda: cluster.try_get(
+        "RoleBinding", "default", "volsync-src-rb") is not None)
+    role = cluster.get("Role", "default", "volsync-src-rb")
+    assert role.rules[0].verbs == ["use"]
+    assert role.rules[0].resource_names == ["volsync-mover"]
+    binding = cluster.get("RoleBinding", "default", "volsync-src-rb")
+    assert binding.role_name == "volsync-src-rb"
+    assert ("ServiceAccount", "volsync-src-rb") in binding.subjects
+
+
+def test_direct_rwo_mover_pinned_to_app_node(world, tmp_path, rng):
+    """An app on node-b holds the RWO volume; a Direct-copy mover must
+    land on node-b (the two-runner cluster would otherwise deadlock the
+    mount)."""
+    cluster, _ = world
+    vol = _volume(cluster, "app-data")
+    import pathlib
+
+    pathlib.Path(vol.status.path, "f.bin").write_bytes(rng.bytes(50_000))
+
+    app = Deployment(
+        metadata=ObjectMeta(name="app", namespace="default"),
+        spec=DeploymentSpec(
+            entrypoint="app", volumes={"data": "app-data"},
+            node_selector={"kubernetes.io/hostname": "node-b"}),
+    )
+    cluster.create(app)
+    wait(cluster, lambda: (
+        (d := cluster.try_get("Deployment", "default", "app"))
+        and d.status.ready_replicas > 0 and d.status.node == "node-b"))
+
+    cluster.create(Secret(
+        metadata=ObjectMeta(name="sec-a", namespace="default"),
+        data={"RESTIC_REPOSITORY": str(tmp_path / "r2").encode(),
+              "RESTIC_PASSWORD": b"x"}))
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="pin", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="app-data", trigger=ReplicationTrigger(manual="go"),
+            restic=ReplicationSourceResticSpec(
+                repository="sec-a", copy_method=CopyMethod.DIRECT)),
+    )
+    cluster.create(rs)
+    wait(cluster, lambda: (
+        (cr := cluster.try_get("ReplicationSource", "default", "pin"))
+        and cr.status and cr.status.last_manual_sync == "go"))
+    # The mover Job carried the pin and actually ran on node-b.
+    evs = [e for e in cluster.events_for(
+        cluster.get("ReplicationSource", "default", "pin"))]
+    assert evs  # sanity: the sync produced events
+    # Job is cleaned up after the sync; the proof it was pinned is that it
+    # completed at all — runner-a would never pick it up. Re-run with a
+    # paused runner-b would hang; instead assert via a fresh Job snapshot:
+    # re-trigger and catch the Job mid-flight.
+    cr = cluster.get("ReplicationSource", "default", "pin")
+    cr.spec.trigger = ReplicationTrigger(manual="again")
+    cluster.update(cr)
+    seen = {}
+
+    def catch():
+        job = cluster.try_get("Job", "default", "volsync-src-pin")
+        if job is not None and job.spec.node_selector:
+            seen["sel"] = dict(job.spec.node_selector)
+            seen["node"] = job.status.node
+        cr = cluster.try_get("ReplicationSource", "default", "pin")
+        return cr.status and cr.status.last_manual_sync == "again"
+
+    wait(cluster, catch)
+    assert seen.get("sel") == {"kubernetes.io/hostname": "node-b"}
+
+
+def test_clone_copy_is_not_pinned(world, tmp_path, rng):
+    """Clone/Snapshot movers mount a fresh PiT copy nobody else uses —
+    no pinning (the reference's behavior falls out the same way)."""
+    cluster, _ = world
+    vol = _volume(cluster, "free-data")
+    import pathlib
+
+    pathlib.Path(vol.status.path, "f").write_bytes(rng.bytes(1000))
+    cluster.create(Secret(
+        metadata=ObjectMeta(name="sec-f", namespace="default"),
+        data={"RESTIC_REPOSITORY": str(tmp_path / "r3").encode(),
+              "RESTIC_PASSWORD": b"x"}))
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="free", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="free-data", trigger=ReplicationTrigger(manual="go"),
+            restic=ReplicationSourceResticSpec(
+                repository="sec-f", copy_method=CopyMethod.CLONE)),
+    )
+    cluster.create(rs)
+    seen = {}
+
+    def catch():
+        job = cluster.try_get("Job", "default", "volsync-src-free")
+        if job is not None:
+            seen["sel"] = dict(job.spec.node_selector)
+        cr = cluster.try_get("ReplicationSource", "default", "free")
+        return cr.status and cr.status.last_manual_sync == "go"
+
+    wait(cluster, catch)
+    assert seen.get("sel") == {}

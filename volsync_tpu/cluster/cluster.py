@@ -191,10 +191,17 @@ class Cluster:
                 obj.metadata.resource_version = 0
                 return self.create(obj)
 
-    def update_status(self, obj):
-        """Status-subresource style write: merge only status."""
+    def update_status(self, obj, *, expect_version: Optional[int] = None):
+        """Status-subresource style write: merge only status.
+        ``expect_version`` makes it a CAS — runners use this to atomically
+        claim a Job/Deployment so two nodes never double-start one."""
         with self._lock:
             current = self.get(obj.kind, *obj.metadata.key)
+            if expect_version is not None and (
+                current.metadata.resource_version != expect_version
+            ):
+                raise Conflict(
+                    f"{obj.kind} {obj.metadata.key}: stale status write")
             current.status = obj.status
             current.metadata.resource_version = self._bump()
         self._after_write(current)

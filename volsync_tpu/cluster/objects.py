@@ -13,6 +13,11 @@ from typing import Optional
 
 from volsync_tpu.api.common import ObjectMeta
 
+#: Node-identity label used by the scheduler (runner node_labels) and the
+#: affinity producer (controller/utils.affinity_from_volume) — one wire
+#: constant so the selector and the labels can never drift apart.
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
 
 @dataclasses.dataclass
 class VolumeSpec:
@@ -152,6 +157,32 @@ class ServiceAccount:
 
 
 @dataclasses.dataclass
+class PolicyRule:
+    """One RBAC rule (rbacv1.PolicyRule shape, trimmed to what the
+    per-CR mover identity needs — utils/sahandler.go:47-55)."""
+
+    api_groups: list = dataclasses.field(default_factory=list)
+    resources: list = dataclasses.field(default_factory=list)
+    resource_names: list = dataclasses.field(default_factory=list)
+    verbs: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Role:
+    metadata: ObjectMeta
+    rules: list = dataclasses.field(default_factory=list)  # [PolicyRule]
+    kind: str = "Role"
+
+
+@dataclasses.dataclass
+class RoleBinding:
+    metadata: ObjectMeta
+    role_name: str = ""
+    subjects: list = dataclasses.field(default_factory=list)  # [(kind, name)]
+    kind: str = "RoleBinding"
+
+
+@dataclasses.dataclass
 class DeploymentSpec:
     """Always-on mover (the live-sync daemon runs as a Deployment, not a
     Job — syncthing/mover.go:389-522)."""
@@ -161,6 +192,7 @@ class DeploymentSpec:
     volumes: dict = dataclasses.field(default_factory=dict)
     secrets: dict = dataclasses.field(default_factory=dict)
     replicas: int = 1
+    node_selector: dict = dataclasses.field(default_factory=dict)
     service_account: Optional[str] = None
 
 
@@ -202,6 +234,8 @@ KINDS = {
     "Service": Service,
     "Secret": Secret,
     "ServiceAccount": ServiceAccount,
+    "Role": Role,
+    "RoleBinding": RoleBinding,
     "Deployment": Deployment,
     "Event": Event,
 }

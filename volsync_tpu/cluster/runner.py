@@ -21,6 +21,8 @@ from datetime import datetime, timezone
 from pathlib import Path
 from typing import Callable, Optional
 
+from volsync_tpu.cluster.objects import HOSTNAME_LABEL
+
 log = logging.getLogger("volsync_tpu.runner")
 
 
@@ -90,10 +92,18 @@ class JobRunner:
     """Watches the cluster and executes runnable Jobs and Deployments."""
 
     def __init__(self, cluster, catalog: EntrypointCatalog = CATALOG,
-                 max_workers: int = 8):
+                 max_workers: int = 8, node_name: str = "node-0",
+                 node_labels: Optional[dict] = None):
         self.cluster = cluster
         self.catalog = catalog
         self.max_workers = max_workers
+        # The runner is the kubelet analogue: one runner = one node. A
+        # payload with a node_selector only runs on a runner whose labels
+        # satisfy it (the affinity pinning of utils/affinity.go:35-83 —
+        # two runners with different hostnames model a two-node cluster).
+        self.node_name = node_name
+        self.node_labels = dict(node_labels or {})
+        self.node_labels.setdefault(HOSTNAME_LABEL, node_name)
         self._stop = threading.Event()
         self._running: dict[tuple, threading.Thread] = {}
         self._daemon_stops: dict[tuple, threading.Event] = {}
@@ -155,7 +165,16 @@ class JobRunner:
                     return
                 key = ("Deployment",) + dep.metadata.key
                 alive = key in self._running and self._running[key].is_alive()
-                if dep.spec.replicas >= 1 and not alive:
+                if alive and not self._selector_matches(dep.spec):
+                    # Selector moved away from this node mid-flight: stop
+                    # our instance so the right node can take over (the
+                    # selector only *gates* starts; stop/pause handling
+                    # below must still run for daemons we already host).
+                    self._daemon_stops[key].set()
+                elif (dep.spec.replicas >= 1 and not alive
+                        and self._selector_matches(dep.spec)
+                        and not (dep.status.ready_replicas > 0
+                                 and dep.status.node not in (None, self.node_name))):
                     stop = threading.Event()
                     self._daemon_stops[key] = stop
                     t = threading.Thread(
@@ -172,6 +191,10 @@ class JobRunner:
                 if self.cluster.try_get(kind, ns, name) is None:
                     stop.set()
 
+    def _selector_matches(self, spec) -> bool:
+        sel = getattr(spec, "node_selector", None) or {}
+        return all(self.node_labels.get(k) == v for k, v in sel.items())
+
     def _job_runnable(self, job) -> bool:
         s = job.status
         if job.spec.parallelism == 0:   # paused (rsync/mover.go:366-370)
@@ -181,6 +204,8 @@ class JobRunner:
         if s.failed > job.spec.backoff_limit:
             return False
         if job.spec.entrypoint not in self.catalog:
+            return False
+        if not self._selector_matches(job.spec):
             return False
         return self._mounts_ready(job.spec, job.metadata.namespace)
 
@@ -212,12 +237,25 @@ class JobRunner:
         try:
             if not self._mounts_ready(job.spec, job.metadata.namespace):
                 return
+            # Atomic claim (CAS on resourceVersion): with several runners
+            # (nodes) watching one cluster, exactly one may flip the Job
+            # active — a lost race means another node took it.
+            job = self.cluster.try_get("Job", *job.metadata.key)
+            if job is None or job.status.active > 0 or job.status.succeeded > 0:
+                return
+            claim_version = job.metadata.resource_version
             mounts, secrets = self._resolve(job.metadata, job.spec)
             job.status.active = 1
+            job.status.node = self.node_name
             job.status.start_time = job.status.start_time or datetime.now(
                 timezone.utc
             )
-            self.cluster.update_status(job)
+            from volsync_tpu.cluster.cluster import Conflict
+
+            try:
+                self.cluster.update_status(job, expect_version=claim_version)
+            except Conflict:
+                return  # another runner claimed it first
             ctx = JobContext(
                 name=job.metadata.name, namespace=job.metadata.namespace,
                 env=dict(job.spec.env), mounts=mounts, secrets=secrets,
@@ -253,6 +291,7 @@ class JobRunner:
 
     def _run_deployment(self, dep, stop):
         key = ("Deployment",) + dep.metadata.key
+        claimed = False
         try:
             while not (stop.is_set() or self._stop.is_set()):
                 if self._mounts_ready(dep.spec, dep.metadata.namespace):
@@ -260,9 +299,23 @@ class JobRunner:
                 self.cluster.wait_for(lambda: stop.is_set(), timeout=0.2)
             if stop.is_set() or self._stop.is_set():
                 return
+            # Atomic claim, as for Jobs: replicas=1 means ONE live daemon
+            # across all runners.
+            dep = self.cluster.try_get("Deployment", *dep.metadata.key)
+            if dep is None or (dep.status.ready_replicas > 0
+                               and dep.status.node != self.node_name):
+                return
+            claim_version = dep.metadata.resource_version
             mounts, secrets = self._resolve(dep.metadata, dep.spec)
             dep.status.ready_replicas = 1
-            self.cluster.update_status(dep)
+            dep.status.node = self.node_name
+            from volsync_tpu.cluster.cluster import Conflict
+
+            try:
+                self.cluster.update_status(dep, expect_version=claim_version)
+            except Conflict:
+                return
+            claimed = True
             ctx = JobContext(
                 name=dep.metadata.name, namespace=dep.metadata.namespace,
                 env=dict(dep.spec.env), mounts=mounts, secrets=secrets,
@@ -279,8 +332,10 @@ class JobRunner:
                     self.cluster.update_status(fresh)
         finally:
             fresh = self.cluster.try_get("Deployment", *dep.metadata.key)
-            if fresh is not None and fresh.metadata.uid == dep.metadata.uid:
+            if (claimed and fresh is not None
+                    and fresh.metadata.uid == dep.metadata.uid):
                 fresh.status.ready_replicas = 0
+                fresh.status.node = None
                 self.cluster.update_status(fresh)
             with self._lock:
                 self._running.pop(key, None)

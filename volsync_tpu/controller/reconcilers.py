@@ -155,26 +155,42 @@ class _ReconcilerBase:
             return ReconcileResult()  # deleted; GC is ownership-driven
         try:
             machine = self._build_machine(cr)
-        except (NoMoverFound, MultipleMoversFound) as e:
-            # Permanent spec problem (zero or 2+ mover sections): surface
-            # it on the CR and park — retrying cannot fix a config error
-            # (the reference rejects these the same way,
-            # replicationsource_controller.go:104-119).
-            cr.ensure_status()
-            upsert_condition(
-                cr.status.conditions,
-                Condition(type=statemachine.COND_SYNCHRONIZING,
-                          status=ConditionStatus.FALSE,
-                          reason=statemachine.REASON_ERROR,
-                          message=str(e)),
-            )
-            self.cluster.update_status(cr)
-            return ReconcileResult()
+        except NoMoverFound as e:
+            # spec.external means an out-of-tree provisioner owns the data
+            # motion: no internal mover is an expected, healthy state and
+            # VolSync must leave the CR alone entirely
+            # (replicationsource_controller.go:103-106).
+            if getattr(cr.spec, "external", None) is not None:
+                return ReconcileResult()
+            return self._park_with_error(cr, e)
+        except MultipleMoversFound as e:
+            return self._park_with_error(cr, e)
+        if getattr(cr.spec, "external", None) is not None:
+            # Both an internal mover section and spec.external is a config
+            # conflict (replicationsource_controller.go:107-117).
+            return self._park_with_error(cr, ValueError(
+                "spec defines both an internal mover and spec.external"))
         try:
             result = statemachine.run(machine, now)
         finally:
             self.cluster.update_status(cr)
         return result
+
+    def _park_with_error(self, cr, e) -> ReconcileResult:
+        """Permanent spec problem (zero or 2+ mover sections, internal +
+        external conflict): surface it on the CR and park — retrying
+        cannot fix a config error (the reference rejects these the same
+        way, replicationsource_controller.go:104-119)."""
+        cr.ensure_status()
+        upsert_condition(
+            cr.status.conditions,
+            Condition(type=statemachine.COND_SYNCHRONIZING,
+                      status=ConditionStatus.FALSE,
+                      reason=statemachine.REASON_ERROR,
+                      message=str(e)),
+        )
+        self.cluster.update_status(cr)
+        return ReconcileResult()
 
     def _bound_metrics(self, cr, mover):
         return self.metrics.for_object(

@@ -12,7 +12,13 @@ from typing import Callable, Iterable, Optional
 
 from volsync_tpu.api.common import ObjectMeta
 from volsync_tpu.cluster.cluster import Cluster
-from volsync_tpu.cluster.objects import ServiceAccount
+from volsync_tpu.cluster.objects import (
+    HOSTNAME_LABEL,
+    PolicyRule,
+    Role,
+    RoleBinding,
+    ServiceAccount,
+)
 
 # labels.go:20-107
 CREATED_BY_LABEL = "app.kubernetes.io/created-by"
@@ -23,7 +29,13 @@ SNAPNAME_ANNOTATION = "volsync.backube/snapname"
 
 # Kinds swept by cleanup, in dependency order (cleanup.go:48-76).
 CLEANUP_KINDS = ("Job", "Deployment", "Service", "VolumeSnapshot", "Volume",
-                 "Secret", "ServiceAccount")
+                 "Secret", "RoleBinding", "Role", "ServiceAccount")
+
+#: The privilege the per-CR Role grants "use" of — the analogue of the
+#: reference's OpenShift SCC named by --scc-name (sahandler.go:32-36,
+#: default "volsync-mover"): here it names the runner policy that allows a
+#: payload to execute on the shared TPU substrate.
+DEFAULT_RUNNER_POLICY = "volsync-mover"
 
 
 def owned_by_labels(owner) -> dict:
@@ -96,16 +108,71 @@ def cleanup_objects(cluster: Cluster, owner,
     return n
 
 
-def ensure_service_account(cluster: Cluster, owner, name: str) -> ServiceAccount:
-    """sahandler.go:38-153, minus the OpenShift SCC RoleBinding — the
-    in-process substrate has no SCC analogue; the SA records per-CR
-    identity for the runner's audit trail."""
-    sa = ServiceAccount(
-        metadata=ObjectMeta(name=name, namespace=owner.metadata.namespace)
-    )
+def ensure_service_account(cluster: Cluster, owner, name: str,
+                           runner_policy: str = DEFAULT_RUNNER_POLICY,
+                           ) -> ServiceAccount:
+    """Per-CR mover identity: ServiceAccount + Role granting ``use`` of
+    the runner policy + RoleBinding tying them together — the full
+    sahandler.go:38-153 triple (SA, Role with use-SCC rule :47-55,
+    RoleBinding :56-62), with the SCC name replaced by the runner-policy
+    name."""
+    ns = owner.metadata.namespace
+    sa = ServiceAccount(metadata=ObjectMeta(name=name, namespace=ns))
     set_owned_by(sa, owner, cluster)
     mark_for_cleanup(sa, owner)
-    return cluster.apply(sa)
+    sa = cluster.apply(sa)
+
+    role = Role(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        rules=[PolicyRule(api_groups=["policy.volsync.backube"],
+                          resources=["runnerpolicies"],
+                          resource_names=[runner_policy],
+                          verbs=["use"])],
+    )
+    set_owned_by(role, owner, cluster)
+    mark_for_cleanup(role, owner)
+    cluster.apply(role)
+
+    binding = RoleBinding(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        role_name=name,
+        subjects=[("ServiceAccount", name)],
+    )
+    set_owned_by(binding, owner, cluster)
+    mark_for_cleanup(binding, owner)
+    cluster.apply(binding)
+    return sa
+
+
+def affinity_from_volume(cluster: Cluster, namespace: str,
+                         volume_name: str) -> dict:
+    """Node pinning for movers that mount a live, single-attach volume
+    (utils/affinity.go:35-83 + docs/design/rwo-affinity.rst): if the
+    volume is RWO/RWOP and a running non-VolSync workload already mounts
+    it, the mover must land on that workload's node or its mount would
+    fail. Returns a node_selector ({} = unconstrained).
+
+    With Clone/Snapshot copy methods the mover mounts a fresh PiT copy
+    that nothing else uses, so no workload is found and no pinning
+    happens — Direct is the case this exists for, exactly like the
+    reference.
+    """
+    vol = cluster.try_get("Volume", namespace, volume_name)
+    if vol is None:
+        return {}
+    modes = set(vol.spec.access_modes or [])
+    if modes and not (modes & {"ReadWriteOnce", "ReadWriteOncePod"}):
+        return {}  # shared-attach volumes need no pinning
+    for kind, running in (("Job", lambda s: s.active > 0),
+                          ("Deployment", lambda s: s.ready_replicas > 0)):
+        for obj in cluster.list(kind, namespace):
+            if obj.metadata.labels.get(CREATED_BY_LABEL) == CREATED_BY_VALUE:
+                continue  # ignore our own movers (podsUsingPVC :86-104)
+            if volume_name not in obj.spec.volumes.values():
+                continue
+            if running(obj.status) and obj.status.node:
+                return {HOSTNAME_LABEL: obj.status.node}
+    return {}
 
 
 def get_and_validate_secret(cluster: Cluster, namespace: str, name: str,

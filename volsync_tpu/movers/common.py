@@ -78,8 +78,20 @@ def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
         # gets a fresh Job (cleanup collects the old one), picking up the
         # new desired spec then.
         want_par = 0 if paused else 1
+        dirty = False
         if existing.spec.parallelism != want_par:
             existing.spec.parallelism = want_par
+            dirty = True
+        # Affinity is re-resolved every reconcile (the reference computes
+        # it fresh each ensureJob — utils/affinity.go:35): as long as the
+        # Job hasn't started, a late-arriving app workload can still pin
+        # it to the right node.
+        want_sel = dict(node_selector or {})
+        if (existing.status.active == 0 and existing.status.succeeded == 0
+                and want_sel and existing.spec.node_selector != want_sel):
+            existing.spec.node_selector = want_sel
+            dirty = True
+        if dirty:
             existing = cluster.update(existing)
         return existing if existing.status.succeeded > 0 else None
     job = Job(
@@ -95,8 +107,9 @@ def reconcile_job(cluster, owner, name: str, *, entrypoint: str, env: dict,
     utils.set_owned_by(job, owner, cluster)
     utils.mark_for_cleanup(job, owner)
     job = cluster.create(job)
-    cluster.record_event(owner, "Normal", base.EV_TRANSFER_STARTED,
-                         f"mover job {name} created", base.ACT_CREATING)
+    if not paused:  # a paused Job (parallelism 0) hasn't started anything
+        cluster.record_event(owner, "Normal", base.EV_TRANSFER_STARTED,
+                             f"mover job {name} created", base.ACT_CREATING)
     return job if job.status.succeeded > 0 else None
 
 

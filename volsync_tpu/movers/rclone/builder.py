@@ -82,6 +82,8 @@ class RcloneSourceMover:
             backoff_limit=2,  # rclone/mover.go:225
             paused=self.paused, service_account=sa.metadata.name,
             metrics=self.metrics,
+            node_selector=utils.affinity_from_volume(
+                self.cluster, ns, data_vol.metadata.name),
         )
         if job is None:
             return Result.in_progress()
@@ -136,6 +138,8 @@ class RcloneDestinationMover:
             secrets={SECRET_MOUNT: secret.metadata.name},
             backoff_limit=2, paused=self.paused,
             service_account=sa.metadata.name, metrics=self.metrics,
+            node_selector=utils.affinity_from_volume(
+                self.cluster, ns, dest.metadata.name),
         )
         if job is None:
             return Result.in_progress()

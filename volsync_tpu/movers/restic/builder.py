@@ -88,6 +88,8 @@ class ResticSourceMover:
             backoff_limit=8,  # restic/mover.go:286
             paused=self.paused, service_account=sa.metadata.name,
             metrics=self.metrics,
+            node_selector=utils.affinity_from_volume(
+                self.cluster, ns, data_vol.metadata.name),
         )
         if job is None:
             return Result.in_progress()
@@ -171,6 +173,8 @@ class ResticDestinationMover:
                      "cache": cache.metadata.name},
             backoff_limit=8, paused=self.paused,
             service_account=sa.metadata.name, metrics=self.metrics,
+            node_selector=utils.affinity_from_volume(
+                self.cluster, ns, dest.metadata.name),
         )
         if job is None:
             return Result.in_progress()

@@ -67,6 +67,8 @@ class RsyncDestinationMover:
             volumes={"data": dest.metadata.name},
             secrets={"keys": secret.metadata.name},
             backoff_limit=2, paused=self.paused, metrics=self.metrics,
+            node_selector=utils.affinity_from_volume(
+                self.cluster, ns, dest.metadata.name),
         )
         # Publish the address once the listener has bound its port
         # (ensureServiceAndPublishAddress blocks on this —
@@ -170,6 +172,8 @@ class RsyncSourceMover:
             secrets={"keys": self.spec.ssh_keys},
             backoff_limit=2, paused=self.paused,
             service_account=sa.metadata.name, metrics=self.metrics,
+            node_selector=utils.affinity_from_volume(
+                self.cluster, ns, data_vol.metadata.name),
         )
         if job is None:
             return Result.in_progress()
