@@ -44,7 +44,24 @@ def _open_remote(ctx, env: dict):
     if not url:
         log.error("section [%s] has no url", section)
         return None, None
-    return open_store(url), env["RCLONE_DEST_PATH"]
+    # rclone.conf remote options -> the AWS env contract open_store
+    # expects (rclone's s3 remotes carry the same fields by these names),
+    # overlaid on the mover env so credentials can come from either the
+    # conf section or the Secret->env passthrough.
+    store_env = dict(env)
+    for opt, var in (("access_key_id", "AWS_ACCESS_KEY_ID"),
+                     ("secret_access_key", "AWS_SECRET_ACCESS_KEY"),
+                     ("endpoint", "AWS_S3_ENDPOINT"),
+                     ("region", "AWS_DEFAULT_REGION")):
+        if cp[section].get(opt):
+            store_env[var] = cp[section][opt]
+    try:
+        return open_store(url, env=store_env), env["RCLONE_DEST_PATH"]
+    except ValueError as ex:
+        # Misconfigured URL/credentials is a config error like the rest of
+        # this function's cases: log and fail the attempt, don't traceback.
+        log.error("cannot open remote [%s] %s: %s", section, url, ex)
+        return None, None
 
 
 def rclone_entrypoint(ctx) -> int:

@@ -45,7 +45,9 @@ def _parse_within(value: str) -> timedelta:
 
 
 def _open_or_init(env: dict) -> Repository:
-    store = open_store(env["RESTIC_REPOSITORY"])
+    # env carries the full Secret passthrough (AWS_* credentials included),
+    # exactly like the reference's mover pod (restic/mover.go:317-364).
+    store = open_store(env["RESTIC_REPOSITORY"], env=env)
     password = env.get("RESTIC_PASSWORD") or None
     try:
         repo = Repository.open(store, password=password)
@@ -129,7 +131,7 @@ def _dispatch(ctx, env: dict, direction: str) -> int:
         return 0
 
     if direction == "restore":
-        repo = Repository.open(open_store(env["RESTIC_REPOSITORY"]),
+        repo = Repository.open(open_store(env["RESTIC_REPOSITORY"], env=env),
                                password=env.get("RESTIC_PASSWORD") or None)
         repo.default_lock_wait = float(env.get("LOCK_WAIT_SECONDS", "120"))
         as_of = (datetime.fromisoformat(env["RESTORE_AS_OF"])

@@ -184,12 +184,16 @@ class MemObjectStore:
         return len(self.get(key))
 
 
-def open_store(url: str) -> ObjectStore:
-    """Open a store by URL: ``file:///path``, ``mem:`` or a bare path.
+def open_store(url: str, env: Optional[dict] = None) -> ObjectStore:
+    """Open a store by URL: ``s3:http://endpoint/bucket/prefix`` or
+    ``s3://bucket/prefix`` (restic's repository URL forms, credentials
+    from ``env`` — the Secret->env passthrough contract of
+    controllers/mover/restic/mover.go:317-364), ``file:///path``,
+    ``mem:``, or a bare path."""
+    if url.startswith("s3:"):
+        from volsync_tpu.objstore.s3 import S3ObjectStore
 
-    (An ``s3://`` scheme would slot in here; this environment has no
-    egress, so it is intentionally not wired.)
-    """
+        return S3ObjectStore.from_url(url, env=env)
     if url.startswith("mem:"):
         return MemObjectStore()
     if url.startswith("file://"):
