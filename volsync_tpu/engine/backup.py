@@ -49,11 +49,24 @@ def _load_parent_files(repo: Repository, parent_tree: str,
 
 
 class TreeBackup:
-    def __init__(self, repo: Repository, *, skip_if_empty: bool = True):
+    def __init__(self, repo: Repository, *, skip_if_empty: bool = True,
+                 hasher=None):
+        """``hasher`` swaps the chunk+hash engine: single-chip
+        DeviceChunkHasher (default) or the mesh-sharded
+        parallel.sharded_chunker.MeshChunkHasher — both produce
+        bit-identical chunks/ids, so snapshots are interchangeable."""
         self.repo = repo
-        self.hasher = DeviceChunkHasher(
+        self.hasher = hasher or DeviceChunkHasher(
             params_from_config(repo.chunker_params))
         self.params = self.hasher.params
+        # An injected hasher chunking under different parameters would
+        # still produce a valid-looking snapshot — but one that shares no
+        # boundaries with prior ones, silently killing dedup. Refuse.
+        want = params_from_config(repo.chunker_params)
+        if self.params != want:
+            raise ValueError(
+                f"hasher params {self.params} != repository chunker "
+                f"params {want}")
         self.skip_if_empty = skip_if_empty
 
     def run(self, root, *, hostname: str = "volsync",

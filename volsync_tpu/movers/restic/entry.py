@@ -79,6 +79,29 @@ def _forget_kwargs(env: dict) -> dict:
 RC_LOCKED = 4
 
 
+#: Mesh hashers memoized per chunker-param set: their shard_map jit caches
+#: live on the instance, so rebuilding per Job would re-pay every XLA
+#: compile each sync iteration.
+_MESH_HASHERS: dict = {}
+
+
+def _select_hasher(env: dict, repo: Repository):
+    """VOLSYNC_ENGINE=mesh shards the scan over the device mesh
+    (parallel/sharded_chunker.py); default is the single-chip engine.
+    Both produce bit-identical snapshots, so the switch is purely a
+    throughput/topology choice."""
+    if env.get("VOLSYNC_ENGINE", "").lower() != "mesh":
+        return None
+    from volsync_tpu.engine.chunker import params_from_config
+    from volsync_tpu.parallel.sharded_chunker import MeshChunkHasher
+
+    params = params_from_config(repo.chunker_params)
+    hasher = _MESH_HASHERS.get(params)
+    if hasher is None:
+        hasher = _MESH_HASHERS[params] = MeshChunkHasher(params)
+    return hasher
+
+
 def restic_entrypoint(ctx) -> int:
     env = ctx.env
     direction = env.get("DIRECTION", "backup")
@@ -105,7 +128,7 @@ def _dispatch(ctx, env: dict, direction: str) -> int:
             return 0
         repo = _open_or_init(env)
         t0 = time.perf_counter()
-        snap_id, stats = TreeBackup(repo).run(
+        snap_id, stats = TreeBackup(repo, hasher=_select_hasher(env, repo)).run(
             data, hostname=env.get("HOSTNAME", "volsync"))
         log.info("backup snapshot=%s stats=%s", snap_id, stats.as_dict())
         ctx.report_transfer(stats.bytes_scanned, time.perf_counter() - t0)

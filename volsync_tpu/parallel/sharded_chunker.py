@@ -1,0 +1,277 @@
+"""Mesh-sharded CDC chunk+hash: the multi-chip product path.
+
+``MeshChunkHasher`` is a drop-in for ``engine.chunker.DeviceChunkHasher``
+(same ``process(buffer, eof)`` protocol), so ``stream_chunks`` /
+``TreeBackup`` — the real backup path — run sharded over a device mesh
+with no orchestration changes. The reference has *no* intra-volume
+parallelism at all (SURVEY.md §5 long-context note: rsync/restic stream
+single-threaded); sharding one volume's scan across chips is the TPU
+framework's core win.
+
+Per segment, two shard_map kernels over a 1-D ``seq`` ring of devices:
+
+1. **Candidates** — each shard gear-hashes its slice with a 31-byte left
+   halo from its neighbor (``ppermute``; the same seam pattern ring
+   attention uses), masks strict/lax CDC candidates, and compacts them to
+   per-shard index lists. Shard 0 zeroes its halo contribution so
+   positions hash exactly as the unsharded recurrence started from h=0.
+2. **Leaf digests** — after the host's sparse FastCDC boundary walk
+   (identical to the single-chip walk, so boundaries are bit-identical),
+   every 4 KiB Merkle leaf of every chunk is assigned to the shard its
+   start falls in; each shard takes a 4095-byte *right* halo so leaves
+   crossing the seam read their tail from the neighbor, and hashes its
+   leaves as independent gather lanes (ops/sha256.sha256_chunks_device).
+
+Blob ids then assemble host-side from the leaf digests (repo/blobid.py),
+byte-identical to the single-device path — golden tests enforce equality
+against both DeviceChunkHasher and hashlib.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from volsync_tpu.engine.chunker import _pow2ceil
+from volsync_tpu.ops.gearcdc import GearParams, _mix_u32, select_boundaries
+from volsync_tpu.repo import blobid
+
+_HALO = 31              # gear window context (see parallel/engine.py)
+_LEAF = blobid.LEAF_SIZE
+SEQ = "seq"
+
+
+def make_stream_mesh(devices=None):
+    """All devices as one ``seq`` ring — a single volume's byte stream
+    shards across every chip (the wave axis of parallel/mesh.py batches
+    *independent* streams; one big backup wants the whole machine)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (SEQ,))
+
+
+class MeshChunkHasher:
+    """chunk+hash a byte buffer sharded over a device mesh.
+
+    Compile-count discipline matches DeviceChunkHasher: shard lengths are
+    drawn from pow2 buckets, candidate/leaf capacities from doubling
+    buckets, so steady-state streaming reuses a handful of compiled
+    programs regardless of workload shape.
+    """
+
+    def __init__(self, params: GearParams, mesh=None):
+        import jax
+
+        self.params = params
+        self.mesh = mesh if mesh is not None else make_stream_mesh()
+        self.n_shards = self.mesh.devices.size
+        self._cand_cache: dict = {}
+        self._leaf_cache: dict = {}
+        self._jax = jax
+
+    # -- public protocol (mirrors DeviceChunkHasher.process) ----------------
+
+    def process(self, buffer, *, eof: bool = True) -> list[tuple[int, int, str]]:
+        if isinstance(buffer, (bytes, bytearray, memoryview)):
+            buffer = np.frombuffer(buffer, dtype=np.uint8)
+        length = int(buffer.shape[0])
+        if length == 0:
+            return []
+        p = self.params
+        if length <= p.min_size:
+            if not eof:
+                return []
+            return [(0, length, blobid.blob_id(buffer.tobytes()))]
+
+        data, shard_len = self._upload(buffer, length)
+        idx_s, idx_l = self._candidates(data, shard_len, length)
+        chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
+        if not chunks:
+            return []
+        hexes = self._span_roots(data, shard_len, chunks)
+        return [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)]
+
+    # -- upload -------------------------------------------------------------
+
+    def _upload(self, buffer: np.ndarray, length: int):
+        """Pad to S * pow2-bucketed shard length, lay out [S, Ls] with
+        shard i holding bytes [i*Ls, (i+1)*Ls)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        S = self.n_shards
+        shard_len = _pow2ceil((length + S - 1) // S, max(_LEAF, 64 * 1024))
+        padded = S * shard_len
+        if padded != length:
+            buffer = np.pad(buffer, (0, padded - length))
+        host = buffer.reshape(S, shard_len)
+        data = jax.device_put(
+            host, NamedSharding(self.mesh, P(SEQ, None)))
+        return data, shard_len
+
+    # -- kernel 1: CDC candidates -------------------------------------------
+
+    def _cand_fn(self, shard_len: int, cap: int):
+        key = (shard_len, cap)
+        fn = self._cand_cache.get(key)
+        if fn is None:
+            fn = _build_cand_fn(self.mesh, self.params, shard_len, cap)
+            self._cand_cache[key] = fn
+        return fn
+
+    def _candidates(self, data, shard_len: int, length: int):
+        # Expected strict-candidate density is 2^-(bits+norm); 1/64 bytes
+        # covers any mask down to 2^-6 (same bound as DeviceChunkHasher).
+        cap = max(_pow2ceil(shard_len // 64, 1024), 1024)
+        while True:
+            idx_s, cnt_s, idx_l, cnt_l = self._cand_fn(shard_len, cap)(
+                data, np.int32(length))
+            cnt_s = np.asarray(cnt_s)
+            cnt_l = np.asarray(cnt_l)
+            worst = int(max(cnt_s.max(), cnt_l.max()))
+            if worst <= cap:
+                break
+            cap = _pow2ceil(worst, cap * 2)  # dense data: retry, recompile
+        idx_s = np.asarray(idx_s)
+        idx_l = np.asarray(idx_l)
+        # Per-shard compacted lists -> one globally sorted list (shards
+        # are contiguous byte ranges in order, so concatenation sorts).
+        out_s = np.concatenate([idx_s[i, : int(cnt_s[i])]
+                                for i in range(self.n_shards)])
+        out_l = np.concatenate([idx_l[i, : int(cnt_l[i])]
+                                for i in range(self.n_shards)])
+        return out_s, out_l
+
+    # -- kernel 2: Merkle leaf digests --------------------------------------
+
+    def _leaf_fn(self, shard_len: int, cap: int):
+        key = (shard_len, cap)
+        fn = self._leaf_cache.get(key)
+        if fn is None:
+            fn = _build_leaf_fn(self.mesh, shard_len, cap)
+            self._leaf_cache[key] = fn
+        return fn
+
+    def _span_roots(self, data, shard_len: int,
+                    chunks: list[tuple[int, int]]) -> list[str]:
+        S = self.n_shards
+        # Assign every leaf to the shard its start falls in; record
+        # (shard, slot) per leaf for reassembly.
+        per_shard: list[list[tuple[int, int]]] = [[] for _ in range(S)]
+        placement: list[tuple[int, int]] = []  # leaf -> (shard, slot)
+        spans: list[tuple[int, int]] = []      # chunk -> (first leaf, count)
+        for start, clen in chunks:
+            first = len(placement)
+            n = blobid.leaf_count(clen)
+            for k in range(n):
+                off = start + k * _LEAF
+                llen = min(_LEAF, start + clen - off)
+                shard = off // shard_len
+                slot = len(per_shard[shard])
+                per_shard[shard].append((off - shard * shard_len, llen))
+                placement.append((shard, slot))
+            spans.append((first, n))
+
+        cap = _pow2ceil(max((len(v) for v in per_shard), default=1),
+                        max(shard_len // _LEAF // 8, 128))
+        starts = np.zeros((S, cap), np.int32)
+        lengths = np.zeros((S, cap), np.int32)
+        for s in range(S):
+            for slot, (off, llen) in enumerate(per_shard[s]):
+                starts[s, slot] = off
+                lengths[s, slot] = llen
+        digests = np.asarray(
+            self._leaf_fn(shard_len, cap)(data, starts, lengths)
+        ).astype(">u4")  # [S, cap, 8] big-endian
+        flat = digests.tobytes()
+
+        def leaf_bytes(shard: int, slot: int) -> bytes:
+            base = (shard * cap + slot) * 32
+            return flat[base: base + 32]
+
+        out = []
+        for (first, n), (_, clen) in zip(spans, chunks):
+            leaves = [leaf_bytes(*placement[first + k]) for k in range(n)]
+            out.append(blobid.root_from_leaves(clen, leaves))
+        return out
+
+
+def _build_cand_fn(mesh, params: GearParams, shard_len: int, cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.parallel.engine import _gear_doubling
+
+    seed = np.uint32(params.seed & 0xFFFFFFFF)
+    mask_s = np.uint32(params.mask_s)
+    mask_l = np.uint32(params.mask_l)
+
+    def local(data, valid_len):  # data: [1, Ls] this shard's slice
+        n = jax.lax.axis_size(SEQ)
+        i = jax.lax.axis_index(SEQ)
+        row = data[0]
+        # Left halo: previous shard's 31-byte tail, shifted right around
+        # the ring; shard 0 (true stream start) contributes zero table
+        # values for its halo positions, reproducing the unsharded
+        # recurrence's h=0 start (see parallel/engine.py local_step).
+        halo = jax.lax.ppermute(
+            row[-_HALO:], SEQ, [(j, (j + 1) % n) for j in range(n)])
+        ext = jnp.concatenate([halo, row])
+        g = _mix_u32(ext.astype(jnp.uint32) + seed)
+        g = jnp.where((i == 0) & (jnp.arange(ext.shape[0]) < _HALO),
+                      jnp.uint32(0), g)
+        h = _gear_doubling(g)[_HALO:]  # [Ls]
+        pos = i * shard_len + jnp.arange(shard_len, dtype=jnp.int32)
+        ok = pos < valid_len
+        is_s = ((h & mask_s) == 0) & ok
+        is_l = ((h & mask_l) == 0) & ok
+        loc_s = jnp.nonzero(is_s, size=cap, fill_value=shard_len)[0]
+        loc_l = jnp.nonzero(is_l, size=cap, fill_value=shard_len)[0]
+        # Global positions; fill lanes fall off the end harmlessly (the
+        # host slices each shard's list by its true count).
+        return ((i * shard_len + loc_s)[None],
+                jnp.sum(is_s)[None],
+                (i * shard_len + loc_l)[None],
+                jnp.sum(is_l)[None])
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P()),
+        out_specs=(P(SEQ, None), P(SEQ), P(SEQ, None), P(SEQ)),
+    )
+    return jax.jit(sharded)
+
+
+def _build_leaf_fn(mesh, shard_len: int, cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.ops.sha256 import sha256_chunks_device
+
+    assert shard_len >= _LEAF, "shards must cover at least one leaf"
+
+    def local(data, starts, lengths):  # [1, Ls], [1, cap], [1, cap]
+        n = jax.lax.axis_size(SEQ)
+        row = data[0]
+        # Right halo: my leaves may run up to LEAF-1 bytes past my slice;
+        # fetch the next shard's head (ring: the last shard's wrap-around
+        # halo is never referenced — the stream ends inside it).
+        halo = jax.lax.ppermute(
+            row[: _LEAF - 1], SEQ, [(j, (j - 1) % n) for j in range(n)])
+        ext = jnp.concatenate([row, halo])
+        digests = sha256_chunks_device(
+            ext, starts[0], lengths[0], max_len=_LEAF)
+        return digests[None]  # [1, cap, 8]
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P(SEQ, None), P(SEQ, None)),
+        out_specs=P(SEQ, None, None),
+    )
+    return jax.jit(sharded)
