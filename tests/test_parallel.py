@@ -68,7 +68,7 @@ def test_sharded_step_matches_host(mesh, rng):
 
     # Candidate mask must match an unsharded gear hash (halo correctness).
     h = np.asarray(_gear_lastaxis(jnp.asarray(host), DEFAULT_PARAMS.seed))
-    want_mask = (h & np.uint32(DEFAULT_PARAMS.mask_s)) == 0
+    want_mask = (h & np.uint32(DEFAULT_PARAMS.dense_mask_s)) == 0
     np.testing.assert_array_equal(np.asarray(out["cand_mask"]), want_mask)
 
     stats = {k: int(v) for k, v in out["stats"].items()}
@@ -91,7 +91,7 @@ def test_single_chip_block_matches(rng):
         assert digests[b].astype(">u4").tobytes() == want
     h = np.asarray(_gear_lastaxis(jnp.asarray(data), DEFAULT_PARAMS.seed))
     assert int(cand_count) == int(
-        ((h & np.uint32(DEFAULT_PARAMS.mask_s)) == 0).sum()
+        ((h & np.uint32(DEFAULT_PARAMS.dense_mask_s)) == 0).sum()
     )
 
 

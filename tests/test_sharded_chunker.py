@@ -109,7 +109,8 @@ def test_tree_backup_snapshots_bit_identical(tmp_path, rng):
         return Repository.init(FsObjectStore(tmp_path / name), password="pw",
                                chunker={"min_size": 4096, "avg_size": 16384,
                                         "max_size": 65536,
-                                        "seed": PARAMS.seed})
+                                        "seed": PARAMS.seed,
+                                        "align": PARAMS.align})
 
     r_single = mk_repo("repo-single")
     snap1, _ = TreeBackup(r_single).run(src)

@@ -56,13 +56,12 @@ class TreeBackup:
         parallel.sharded_chunker.MeshChunkHasher — both produce
         bit-identical chunks/ids, so snapshots are interchangeable."""
         self.repo = repo
-        self.hasher = hasher or DeviceChunkHasher(
-            params_from_config(repo.chunker_params))
+        want = params_from_config(repo.chunker_params)
+        self.hasher = hasher or DeviceChunkHasher(want)
         self.params = self.hasher.params
         # An injected hasher chunking under different parameters would
         # still produce a valid-looking snapshot — but one that shares no
         # boundaries with prior ones, silently killing dedup. Refuse.
-        want = params_from_config(repo.chunker_params)
         if self.params != want:
             raise ValueError(
                 f"hasher params {self.params} != repository chunker "

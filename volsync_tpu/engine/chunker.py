@@ -21,13 +21,25 @@ import numpy as np
 
 from volsync_tpu.repo import blobid
 
-from volsync_tpu.ops.gearcdc import GearParams, cdc_candidates, select_boundaries
-from volsync_tpu.ops.sha256 import sha256_chunks_device
+from volsync_tpu.ops.gearcdc import (
+    GearParams,
+    cdc_candidates,
+    cdc_candidates_aligned_packed,
+    select_boundaries,
+)
+from volsync_tpu.ops.sha256 import (
+    sha256_chunks_device,
+    sha256_leaves_device,
+)
 
 
 def params_from_config(cfg: dict) -> GearParams:
+    # Repos written before the aligned-cut format carry no "align" key;
+    # they keep the fully shift-invariant align=1 behavior forever so
+    # their existing chunk boundaries (and dedup) stay valid.
     return GearParams(min_size=cfg["min_size"], avg_size=cfg["avg_size"],
-                      max_size=cfg["max_size"], seed=cfg["seed"])
+                      max_size=cfg["max_size"], seed=cfg["seed"],
+                      align=cfg.get("align", 1))
 
 
 def _pow2ceil(n: int, lo: int = 1) -> int:
@@ -63,27 +75,81 @@ class DeviceChunkHasher:
     def process(self, buffer, *, eof: bool = True) -> list[tuple[int, int, str]]:
         """-> [(start, length, sha256-hex)] covering ``buffer`` (the tail
         is withheld when not ``eof`` — the caller re-feeds it)."""
+        return self.begin(buffer, eof=eof).finish()
+
+    def begin(self, buffer, *, eof: bool = True) -> "PendingSegment":
+        """Upload + dispatch the segment's device work; the boundary walk
+        runs synchronously (it needs only the small candidate fetch), but
+        the heavy leaf hashing is left IN FLIGHT — callers overlap the
+        next segment's host I/O/upload with it and call .finish() late
+        (the double-buffered streaming pipeline)."""
         import jax.numpy as jnp
 
         if isinstance(buffer, (bytes, bytearray, memoryview)):
             buffer = np.frombuffer(buffer, dtype=np.uint8)
         length = int(buffer.shape[0])
         if length == 0:
-            return []
+            return PendingSegment([], None, None)
         p = self.params
         if length <= p.min_size:
             if not eof:
-                return []
-            return [(0, length, blobid.blob_id(buffer.tobytes()))]
+                return PendingSegment([], None, None)
+            return PendingSegment(
+                [(0, length, blobid.blob_id(buffer.tobytes()))], None, None)
 
         padded = _buffer_bucket(length)
         if padded != length:
             buffer = np.pad(buffer, (0, padded - length))
-        dev = jnp.asarray(buffer)
-        # Candidate capacity: one boundary candidate per 64 bytes covers
-        # any mask down to 2^-6 density (avg_size >= 256B with the
-        # default normalization), so ordinary data never retries; only
-        # candidate-dense adversarial data takes the doubling path below.
+        return self.begin_device(jnp.asarray(buffer), length, eof=eof)
+
+    def begin_device(self, dev, length: int, *,
+                     eof: bool = True) -> "PendingSegment":
+        p = self.params
+        idx_s, idx_l = self._candidates(dev, length)
+        chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
+        if not chunks:
+            return PendingSegment([], None, None)
+        if p.align >= 64:
+            plan = _leaf_plan(chunks)
+            full_rows, short_starts, short_lengths = plan[0], plan[1], plan[2]
+            dev_digests = _dispatch_leaves(
+                dev, full_rows, short_starts, short_lengths,
+                leaf_fn=self.leaf_device_fn)
+            return PendingSegment(None, chunks, (plan, dev_digests))
+        # Legacy unaligned path: synchronous gather hashing.
+        hexes = device_span_roots(dev, chunks, aligned=False)
+        return PendingSegment(
+            [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)],
+            None, None)
+
+    def process_device(self, dev, length: int, *,
+                       eof: bool = True) -> list[tuple[int, int, str]]:
+        """The device pipeline on an already-resident padded buffer —
+        what process() runs after upload, and what bench.py measures:
+        candidates -> host boundary walk -> leaf digests -> roots."""
+        return self.begin_device(dev, length, eof=eof).finish()
+
+    def _candidates(self, dev, length: int):
+        p = self.params
+        padded = int(dev.shape[0])
+        if p.align > 1:
+            cand = self.cand_device_fn or (
+                lambda d, cap: cdc_candidates_aligned_packed(
+                    d, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+                    align=p.align, max_candidates=cap, valid_len=length))
+            cap = 4096  # expected count: padded/avg_size << 4096
+            while True:
+                packed = np.asarray(cand(dev, cap))
+                c = int(packed[-1])
+                if c <= cap:
+                    break
+                cap = _pow2ceil(c, cap * 2)
+            pos = packed[:c]
+            flags = packed[cap: cap + c].astype(bool)
+            return pos[flags], pos
+        # Classic unaligned path: one candidate per 64 bytes covers any
+        # mask down to 2^-6 density; denser (adversarial) data retries
+        # with a doubled cap.
         cap = padded // 64
         while True:
             # valid_len masks the zero-padded tail on device: padding can
@@ -95,21 +161,14 @@ class DeviceChunkHasher:
             cs, cl = int(count_s), int(count_l)
             if cs <= cap and cl <= cap:
                 break
-            # Candidate-dense (e.g. adversarial) data overflowed the
-            # capacity: silently truncating would make streaming
-            # boundaries diverge from one-shot chunking. Retry with a
-            # doubled cap (rare; costs one recompile when it happens).
             cap = _pow2ceil(max(cs, cl), cap * 2)
-        idx_s = np.asarray(idx_s)[:cs]
-        idx_l = np.asarray(idx_l)[:cl]
-        chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
-        if not chunks:
-            return []
-        hexes = self._hash_chunks(dev, chunks)
-        return [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)]
+        return np.asarray(idx_s)[:cs], np.asarray(idx_l)[:cl]
 
-    def _hash_chunks(self, dev, chunks: list[tuple[int, int]]) -> list[str]:
-        return device_span_roots(dev, chunks)
+    #: Override points for the two fused device dispatches (benchmarks
+    #: compose a content-salt into the same programs; None = the library
+    #: kernels sha256_leaves_device / cdc_candidates_aligned_packed).
+    leaf_device_fn = None
+    cand_device_fn = None
 
 
 def device_leaf_digests(dev, leaf_starts: list[int],
@@ -133,10 +192,117 @@ def device_leaf_digests(dev, leaf_starts: list[int],
             for k in range(len(leaf_starts))]
 
 
-def device_span_roots(dev, chunks: list[tuple[int, int]]) -> list[str]:
+def _leaf_plan(chunks: list[tuple[int, int]]):
+    """Host-side leaf assignment for a chunk list (aligned cuts): which
+    leaves are full (strided path) vs short tails (gather path), plus the
+    bookkeeping to reassemble per-chunk leaf sequences afterwards."""
+    full_rows: list[int] = []
+    short_starts: list[int] = []
+    short_lengths: list[int] = []
+    slot: list[tuple[bool, int]] = []      # leaf -> (is_full, index)
+    spans: list[tuple[int, int]] = []      # chunk -> (first leaf, count)
+    for start, length in chunks:
+        first = len(slot)
+        n = blobid.leaf_count(length)
+        for k in range(n):
+            off = k * blobid.LEAF_SIZE
+            s = start + off
+            l = min(blobid.LEAF_SIZE, length - off)
+            if l == blobid.LEAF_SIZE:
+                assert s % 64 == 0, "aligned path requires 64B leaf starts"
+                slot.append((True, len(full_rows)))
+                full_rows.append(s // 64)
+            else:
+                slot.append((False, len(short_starts)))
+                short_starts.append(s)
+                short_lengths.append(l)
+        spans.append((first, n))
+    return full_rows, short_starts, short_lengths, slot, spans
+
+
+def _dispatch_leaves(dev, full_rows, short_starts, short_lengths,
+                     leaf_fn=None):
+    """Launch the single fused leaf dispatch; returns the in-flight
+    [F + T, 8] device array (callers fetch it as late as possible)."""
+    import jax.numpy as jnp
+
+    lanes_f = _pow2ceil(len(full_rows), 128)
+    lanes_t = _pow2ceil(max(len(short_starts), 1), 8)
+    rows = np.zeros((lanes_f,), np.int32)
+    rows[: len(full_rows)] = full_rows
+    ts = np.zeros((lanes_t,), np.int32)
+    tl = np.zeros((lanes_t,), np.int32)
+    ts[: len(short_starts)] = short_starts
+    tl[: len(short_lengths)] = short_lengths
+    return (leaf_fn or sha256_leaves_device)(
+        dev, jnp.asarray(rows), jnp.asarray(ts), jnp.asarray(tl),
+        leaf_len=blobid.LEAF_SIZE), lanes_f
+
+
+def _assemble_roots(chunks, plan, digests_np, lanes_f) -> list[str]:
+    full_rows, short_starts, _, slot, spans = plan
+    flat = digests_np.astype(">u4").tobytes()
+
+    def leaf(is_full: bool, i: int) -> bytes:
+        base = (i if is_full else lanes_f + i) * 32
+        return flat[base: base + 32]
+
+    return [
+        blobid.root_from_leaves(length,
+                                [leaf(*slot[first + k]) for k in range(n)])
+        for (first, n), (_, length) in zip(spans, chunks)
+    ]
+
+
+class PendingSegment:
+    """A segment whose boundary walk is done but whose leaf digests may
+    still be computing on device. ``chunks`` is available immediately
+    (the streaming pipeline needs it to advance its buffer); finish()
+    performs the one digest fetch and assembles blob ids."""
+
+    def __init__(self, done, chunks, inflight):
+        self._done = done
+        self._inflight = inflight
+        self.chunks = (chunks if chunks is not None
+                       else [(s, l) for s, l, _ in (done or [])])
+
+    @property
+    def end(self) -> int:
+        """One past the last covered byte (0 if nothing was emitted)."""
+        if not self.chunks:
+            return 0
+        s, l = self.chunks[-1][0], self.chunks[-1][1]
+        return int(s) + int(l)
+
+    def finish(self) -> list[tuple[int, int, str]]:
+        if self._done is not None:
+            return self._done
+        (plan, (dev_digests, lanes_f)) = self._inflight
+        hexes = _assemble_roots(self.chunks, plan,
+                                np.asarray(dev_digests), lanes_f)
+        self._done = [(int(s), int(l), h)
+                      for (s, l), h in zip(self.chunks, hexes)]
+        self._inflight = None
+        return self._done
+
+
+def device_span_roots(dev, chunks: list[tuple[int, int]], *,
+                      aligned: bool = False, leaf_fn=None) -> list[str]:
     """Merkle blob ids for (start, length) slices of the device buffer
     (repo/blobid.py): every 4 KiB leaf of every chunk hashes as one
-    independent lane, then the tiny roots combine host-side."""
+    independent lane, then the tiny roots combine host-side.
+
+    ``aligned=True`` asserts every chunk start is 64-byte aligned
+    (GearParams.align >= 64): full leaves then take the strided
+    row-gather path and only each chunk's short tail leaf (<4 KiB)
+    pays the generic gather kernel, in ONE fused dispatch.
+    """
+    if aligned:
+        plan = _leaf_plan(chunks)
+        dev_digests, lanes_f = _dispatch_leaves(
+            dev, plan[0], plan[1], plan[2], leaf_fn=leaf_fn)
+        return _assemble_roots(chunks, plan, np.asarray(dev_digests),
+                               lanes_f)
     leaf_starts: list[int] = []
     leaf_lengths: list[int] = []
     spans: list[tuple[int, int]] = []  # (first leaf index, count) per chunk
@@ -213,10 +379,17 @@ def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
     ``reader(n)`` returns up to n bytes, b"" at EOF. Segments are chunked
     on device; the unterminated tail of each segment is carried into the
     next so boundaries match one-shot chunking.
+
+    Double-buffered: each segment's boundary walk is synchronous (it
+    gates how far the buffer advances) but its leaf hashing stays in
+    flight while the NEXT segment is read from disk and uploaded — the
+    host I/O and the device SHA-256 overlap, and result round-trips of
+    consecutive segments pipeline.
     """
     hasher = hasher or DeviceChunkHasher(params)
     pending = b""
     eof = False
+    prev: Optional[tuple[bytes, object]] = None  # (segment bytes, pending token)
     while True:
         while not eof and len(pending) < segment_size + params.max_size:
             piece = reader(segment_size)
@@ -224,13 +397,25 @@ def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
                 eof = True
             else:
                 pending += piece
-        consumed = 0
-        for start, length, digest in hasher.process(
-                np.frombuffer(pending, np.uint8), eof=eof):
-            yield pending[start : start + length], digest
-            consumed = start + length
+        begin = getattr(hasher, "begin", None)
+        if begin is not None:
+            token = begin(np.frombuffer(pending, np.uint8), eof=eof)
+        else:
+            # Engines without split-phase support (e.g. the mesh hasher)
+            # still work, just without the overlap.
+            token = PendingSegment(hasher.process(
+                np.frombuffer(pending, np.uint8), eof=eof), None, None)
+        consumed = token.end
+        if prev is not None:
+            seg_bytes, prev_token = prev
+            for start, length, digest in prev_token.finish():
+                yield seg_bytes[start: start + length], digest
+        prev = (pending, token)
         pending = pending[consumed:]
         if eof:
+            seg_bytes, last = prev
+            for start, length, digest in last.finish():
+                yield seg_bytes[start: start + length], digest
             return
         # A non-eof pass over more than max_size bytes always emits at
         # least one chunk (max_size forces a cut), so progress is

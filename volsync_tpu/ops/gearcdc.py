@@ -61,6 +61,15 @@ def _make_gear_table(seed: int) -> np.ndarray:
         return _mix_u32(b + np.uint32(seed & 0xFFFFFFFF))
 
 
+def _pow2ceil_int(n: int, lo: int) -> int:
+    """Pow2 bucketing for retry capacities — arbitrary sizes would mint a
+    fresh XLA compile per distinct value."""
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
 def _top_mask(bits: int) -> int:
     """Mask selecting the top ``bits`` bits of a uint32."""
     bits = max(1, min(bits, 31))
@@ -69,29 +78,74 @@ def _top_mask(bits: int) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class GearParams:
-    """CDC parameters. Defaults mirror restic's chunker envelope."""
+    """CDC parameters. Defaults mirror restic's chunker envelope.
+
+    ``align`` constrains cut positions so every chunk start is a multiple
+    of ``align`` (the mask is evaluated only at eligible positions, with
+    its bit count reduced by log2(align) to keep the same average chunk
+    size). align=64 is the TPU-native default: the gear window at an
+    eligible position sits entirely inside one 64-byte row (no halo), the
+    candidate compaction shrinks 64x, and — the big one — every Merkle
+    leaf becomes 64-byte-row-aligned so leaf hashing runs the strided
+    (gather-free) SHA-256 layout. The trade: chunk boundaries are content
+    -defined only modulo the 64-byte phase, so an insertion of k bytes
+    (k % 64 != 0) inside one large file re-chunks that file's tail
+    (cross-snapshot dedup of unshifted/whole-file/appended data — the
+    dominant backup pattern — is unaffected). ``align=1`` restores the
+    reference engine's fully shift-invariant behavior and the gather
+    hashing path.
+    """
 
     min_size: int = 512 * 1024
     avg_size: int = 1024 * 1024
     max_size: int = 8 * 1024 * 1024
     seed: int = 0x5EED_CDC1
     norm_level: int = 2  # FastCDC normalization: mask_s=bits+n, mask_l=bits-n
+    align: int = 64
 
     def __post_init__(self):
         assert self.min_size >= _WINDOW
         assert self.min_size <= self.avg_size <= self.max_size
         assert self.avg_size & (self.avg_size - 1) == 0, "avg_size must be 2^k"
+        assert self.align >= 1 and self.align & (self.align - 1) == 0
+        if self.align > 1:
+            # The aligned kernel reads the gear window from one row.
+            assert self.align >= _WINDOW, "align must be >= the gear window"
+            assert self.min_size % self.align == 0
+            assert self.max_size % self.align == 0
+            assert self.eff_bits - self.norm_level >= 1, \
+                "avg_size too small for this align/norm combination"
 
     @property
     def bits(self) -> int:
         return int(self.avg_size).bit_length() - 1
 
     @property
+    def eff_bits(self) -> int:
+        """Mask bits after discounting the 1/align eligible positions:
+        candidate density stays 2^-bits overall."""
+        return self.bits - (int(self.align).bit_length() - 1)
+
+    @property
     def mask_s(self) -> int:
-        return _top_mask(self.bits + self.norm_level)
+        """Strict mask for ALIGNED evaluation (applied at 1/align
+        positions — the align discount keeps overall candidate density
+        at 2^-(bits+norm))."""
+        return _top_mask(self.eff_bits + self.norm_level)
 
     @property
     def mask_l(self) -> int:
+        return _top_mask(self.eff_bits - self.norm_level)
+
+    @property
+    def dense_mask_s(self) -> int:
+        """Strict mask for PER-POSITION evaluation (no align discount) —
+        what consumers applying the mask at every byte must use, e.g. the
+        (wave, seq) batch step in parallel/engine.py."""
+        return _top_mask(self.bits + self.norm_level)
+
+    @property
+    def dense_mask_l(self) -> int:
         return _top_mask(self.bits - self.norm_level)
 
     @functools.cached_property
@@ -116,6 +170,67 @@ def gear_hash_positions(data: jax.Array, seed: int) -> jax.Array:
         shifted = jnp.pad(h[:-m], (m, 0))
         h = h + (shifted << np.uint32(m))
     return h
+
+
+def gear_at_aligned(data: jax.Array, seed: int, align: int) -> jax.Array:
+    """Gear hash evaluated only at positions p = r*align + align-1
+    ([L] uint8, L % align == 0 -> [L/align] uint32).
+
+    For align >= 32 the 32-byte window ending at p lies inside row r
+    (columns align-32..align-1), so this is a pure reshape + weighted
+    row-sum: h_p = sum_m G[s_m] << (31-m) over the window bytes s_0..s_31
+    — ~32x less arithmetic than hashing every position, no halo, no
+    shift-doubling passes.
+    """
+    L = data.shape[0]
+    rows = data.reshape(L // align, align)[:, align - _WINDOW:]
+    g = _mix_u32(rows.astype(jnp.uint32) + np.uint32(seed & 0xFFFFFFFF))
+    shifts = np.arange(_WINDOW - 1, -1, -1, dtype=np.uint32)  # 31..0
+    return jnp.sum(g << shifts[None, :], axis=1, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("seed", "max_candidates",
+                                             "mask_s", "mask_l", "align"))
+def cdc_candidates_aligned(data: jax.Array, *, seed: int,
+                           mask_s: int, mask_l: int, align: int,
+                           max_candidates: int, valid_len=None):
+    """Aligned-cut candidate compaction: one nonzero over L/align lanes.
+
+    Because the strict mask's zero-bits are a superset of the lax mask's
+    (top_mask(eff+n) ⊃ top_mask(eff-n)), is_s ⊆ is_l — so only the lax
+    candidates are compacted, each carrying its strict flag; the host
+    splits them. Returns (positions [cap] int32 cut positions, strict
+    flags [cap] bool, true count).
+    """
+    h = gear_at_aligned(data, seed, align)
+    R = h.shape[0]
+    is_s = (h & np.uint32(mask_s)) == 0
+    is_l = (h & np.uint32(mask_l)) == 0
+    if valid_len is not None:
+        pos_ok = (jnp.arange(R, dtype=jnp.int32) * align + (align - 1)) \
+            < valid_len
+        is_s = is_s & pos_ok
+        is_l = is_l & pos_ok
+    ridx = jnp.nonzero(is_l, size=max_candidates, fill_value=R)[0]
+    flags = jnp.where(ridx < R, is_s[jnp.clip(ridx, 0, R - 1)], False)
+    pos = ridx.astype(jnp.int32) * align + (align - 1)
+    return pos, flags, jnp.sum(is_l)
+
+
+@functools.partial(jax.jit, static_argnames=("seed", "mask_s", "mask_l",
+                                             "align", "max_candidates"))
+def cdc_candidates_aligned_packed(data: jax.Array, *, seed: int,
+                                  mask_s: int, mask_l: int, align: int,
+                                  max_candidates: int, valid_len=None):
+    """cdc_candidates_aligned with all three outputs packed into ONE
+    int32 array [2*cap + 1] = (positions, strict flags, count) — a single
+    result fetch per segment (result round-trips dominate on
+    remote-attached devices)."""
+    pos, flags, count = cdc_candidates_aligned(
+        data, seed=seed, mask_s=mask_s, mask_l=mask_l, align=align,
+        max_candidates=max_candidates, valid_len=valid_len)
+    return jnp.concatenate([pos.astype(jnp.int32), flags.astype(jnp.int32),
+                            count[None].astype(jnp.int32)])
 
 
 @functools.partial(jax.jit, static_argnames=("seed", "max_candidates",
@@ -201,6 +316,24 @@ def chunk_buffer(data, params: GearParams = DEFAULT_PARAMS,
         return []
     if length <= params.min_size:
         return [(0, length)] if eof else []
+    if params.align > 1:
+        padded = (length + params.align - 1) // params.align * params.align
+        buf = np.pad(np.asarray(data), (0, padded - length)) \
+            if padded != length else np.asarray(data)
+        dev = jnp.asarray(buf)
+        cap = 4096
+        while True:
+            pos, flags, count = cdc_candidates_aligned(
+                dev, seed=params.seed, mask_s=params.mask_s,
+                mask_l=params.mask_l, align=params.align,
+                max_candidates=cap, valid_len=length)
+            c = int(count)
+            if c <= cap:
+                break
+            cap = _pow2ceil_int(c, cap * 2)
+        pos = np.asarray(pos)[:c]
+        flags = np.asarray(flags)[:c]
+        return select_boundaries(pos[flags], pos, length, params, eof=eof)
     dev = jnp.asarray(data)
     # Expected candidate density is 2^-(bits-norm) for the lax mask; leave
     # generous headroom, and retry exactly if real data is denser.

@@ -223,6 +223,203 @@ def sha256_many(chunks: list[bytes]) -> list[bytes]:
     return digest_bytes(np.asarray(out))[: len(chunks)]
 
 
+def pack_words(data: jax.Array) -> jax.Array:
+    """[L] uint8 (L % 64 == 0) -> [L/64, 16] uint32 big-endian message
+    blocks of the whole buffer — the strided, gather-free layout the
+    aligned leaf path hashes from. NOT independently jitted: callers fuse
+    it into their own jit so the 1x-data-sized word array never
+    materializes across a dispatch boundary.
+
+    Uses a bitcast + byteswap instead of a [L/4, 4]->u32 combine: a
+    uint32[N, 4] intermediate tiles to (8, 128) on TPU, a 32x padding
+    blowup that OOMs at large L."""
+    L = data.shape[0]
+    w_le = jax.lax.bitcast_convert_type(
+        data.reshape(L // 4, 4), jnp.uint32)  # [L/4] little-endian
+    w = ((w_le & np.uint32(0xFF)) << np.uint32(24)) \
+        | ((w_le & np.uint32(0xFF00)) << np.uint32(8)) \
+        | ((w_le >> np.uint32(8)) & np.uint32(0xFF00)) \
+        | (w_le >> np.uint32(24))
+    return w.reshape(L // 64, 16)
+
+
+@functools.partial(jax.jit, static_argnames=("leaf_len",))
+def sha256_leaves_device(data: jax.Array, rows0: jax.Array,
+                         tail_starts: jax.Array, tail_lengths: jax.Array,
+                         *, leaf_len: int = 4096) -> jax.Array:
+    """ONE dispatch for a whole segment's Merkle leaves (aligned cuts).
+
+    data: [L] uint8 resident buffer (L % 64 == 0);
+    rows0: [F] int32 — block row of each FULL leaf (64B-aligned starts);
+    tail_starts/tail_lengths: [T] int32 — the short tail leaves
+    (< leaf_len), hashed via the generic gather path.
+    Returns ONE [F + T, 8] uint32 array (full digests then tail digests)
+    so the host needs exactly one result fetch.
+
+    Packing, the strided full-leaf scan, and the tail gather fuse into a
+    single program so no data-sized intermediate ever crosses a dispatch
+    boundary (which costs ~1 GiB/s-scale stalls on remote-attached
+    devices and wastes HBM on local ones).
+    """
+    wb = pack_words(data)
+    if (leaf_len == 4096 and rows0.shape[0] % _LANE_TILE == 0
+            and use_pallas_leaves()):
+        full = _sha256_rows_pallas(wb, rows0)
+    else:
+        full = _sha256_rows(wb, rows0, leaf_len)
+    tail = sha256_chunks_device(data, tail_starts, tail_lengths,
+                                max_len=leaf_len)
+    return jnp.concatenate([full, tail], axis=0)
+
+
+def _sha256_rows(wb: jax.Array, rows0: jax.Array,
+                 leaf_len: int) -> jax.Array:
+    """SHA-256 of full, 64-byte-row-aligned slices of a packed buffer.
+
+    wb:    [NB, 16] uint32 — pack_words(buffer).
+    rows0: [B] int32 — first block row of each slice (all slices exactly
+           ``leaf_len`` bytes, leaf_len % 64 == 0).
+    returns [B, 8] uint32 digests.
+
+    This is the aligned-cuts fast path (GearParams.align >= 64): every
+    Merkle leaf's message blocks are whole rows of ``wb``, so each scan
+    step is one row-gather [B, 16] — no byte gathers, no padding masks
+    (the FIPS pad for a fixed full length is one constant extra block).
+    Measured ~24x faster than the generic sha256_chunks_device gather
+    path on v5e for 4 KiB leaves.
+    """
+    B = rows0.shape[0]
+    nsteps = leaf_len // 64
+    state0 = jnp.broadcast_to(jnp.asarray(_H0), (B, 8))
+    state0 = state0 ^ (wb[rows0, :8] & jnp.uint32(0))  # varying-axis align
+
+    def step(state, t):
+        return _compress(state, wb[rows0 + t]), None
+
+    state, _ = jax.lax.scan(step, state0,
+                            jnp.arange(nsteps, dtype=jnp.int32))
+    pad = np.zeros((16,), dtype=np.uint32)
+    pad[0] = 0x80000000
+    pad[14] = (leaf_len * 8) >> 32
+    pad[15] = (leaf_len * 8) & 0xFFFFFFFF
+    pad_block = (state[:, :1] & jnp.uint32(0)) ^ jnp.asarray(pad)[None, :]
+    return _compress(state, pad_block)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel for the full-leaf bulk path
+# ---------------------------------------------------------------------------
+#
+# XLA's scan-of-compressions is limited by per-step HBM round-trips of the
+# carry and conservative scheduling. The Pallas kernel keeps the running
+# digest state in a VMEM scratch across a (lane-tile, message-block) grid
+# and unrolls the 64 rounds, so per grid step the only HBM traffic is one
+# 16-word message tile read; the final pad-block compression and the
+# 32-byte digest write happen on the last block step. Measured ~20% faster
+# than the XLA scan on v5e (net of dispatch), bit-exact vs hashlib.
+
+_LANE_SUB = 32                  # sublanes per lane tile (4 u32 vregs/op)
+_LANE_TILE = _LANE_SUB * 128    # leaves per grid row
+
+
+def _rotr_p(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _round64_p(state, w):
+    """One full SHA-256 compression (64 unrolled rounds) on [S, 128]
+    uint32 vector tiles; ``w`` is the 16-entry message-word list (extended
+    in place to 64)."""
+    a, b, c, d, e, f, g, h = state
+    for r in range(64):
+        if r < 16:
+            wt = w[r]
+        else:
+            s0 = (_rotr_p(w[r - 15], 7) ^ _rotr_p(w[r - 15], 18)
+                  ^ (w[r - 15] >> np.uint32(3)))
+            s1 = (_rotr_p(w[r - 2], 17) ^ _rotr_p(w[r - 2], 19)
+                  ^ (w[r - 2] >> np.uint32(10)))
+            wt = w[r - 16] + s0 + w[r - 7] + s1
+            w.append(wt)
+        S1 = _rotr_p(e, 6) ^ _rotr_p(e, 11) ^ _rotr_p(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + np.uint32(_K[r]) + wt
+        S0 = _rotr_p(a, 2) ^ _rotr_p(a, 13) ^ _rotr_p(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + S0 + maj
+    return tuple(x + y for x, y in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+def _sha256_leaf_kernel(x_ref, o_ref, st_ref):
+    """Grid (lane tiles, 64 message blocks), block t fastest. x_ref:
+    [1, 16, S, 128] — this lane tile's words for block t; st_ref: [8, S,
+    128] VMEM scratch carrying the digest state across block steps."""
+    import jax.experimental.pallas as pl
+
+    S = st_ref.shape[1]
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        for j in range(8):
+            st_ref[j] = jnp.full((S, 128), np.uint32(_H0[j]), jnp.uint32)
+
+    state = tuple(st_ref[j] for j in range(8))
+    w = x_ref[0]  # [16, S, 128]
+    state = _round64_p(state, [w[j] for j in range(16)])
+    for j in range(8):
+        st_ref[j] = state[j]
+
+    @pl.when(t == 63)
+    def _():
+        # Constant FIPS pad block for a full 4096-byte message.
+        zero = jnp.zeros((S, 128), jnp.uint32)
+        pad = [zero + np.uint32(0x80000000)] + [zero] * 13 + [
+            zero, zero + np.uint32(4096 * 8)]
+        fin = _round64_p(state, pad)
+        for j in range(8):
+            o_ref[j] = fin[j]
+
+
+def _sha256_rows_pallas(wb: jax.Array, rows0: jax.Array) -> jax.Array:
+    """Full 4 KiB leaves via the Pallas kernel. rows0 length must be a
+    multiple of _LANE_TILE (callers bucket lanes)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = rows0.shape[0]
+    assert B % _LANE_TILE == 0
+    # Gather each leaf's 64 message blocks, lanes minor for the VPU.
+    gathered = wb[rows0[:, None] + jnp.arange(64, dtype=jnp.int32)[None, :]]
+    x = jnp.transpose(gathered, (1, 2, 0))  # [64, 16, B]
+    x = x.reshape(64, 16, B // 128, 128)
+
+    out = pl.pallas_call(
+        _sha256_leaf_kernel,
+        grid=(B // _LANE_TILE, 64),
+        in_specs=[pl.BlockSpec((1, 16, _LANE_SUB, 128),
+                               lambda i, t: (t, 0, i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, _LANE_SUB, 128), lambda i, t: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, B // 128, 128), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((8, _LANE_SUB, 128), jnp.uint32)],
+    )(x)
+    return jnp.transpose(out, (1, 2, 0)).reshape(B, 8)
+
+
+def use_pallas_leaves() -> bool:
+    """The Pallas path runs on real TPU backends; tests/dry-runs on CPU
+    use the XLA scan (identical digests, golden-tested on both).
+    VOLSYNC_NO_PALLAS=1 forces the XLA scan everywhere (operational
+    kill-switch for toolchains without Mosaic support)."""
+    import os
+
+    if os.environ.get("VOLSYNC_NO_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 @functools.partial(jax.jit, static_argnames=("max_len",))
 def sha256_chunks_device(data: jax.Array, starts: jax.Array,
                          lengths: jax.Array, *, max_len: int) -> jax.Array:

@@ -116,7 +116,7 @@ def make_chunk_hash_step(mesh, *, block_len: int = 64 * 1024,
       total_candidates, distinct_block_estimate, duplicate_block_estimate.
     """
     seed = params.seed
-    mask_s = np.uint32(params.mask_s)
+    mask_s = np.uint32(params.dense_mask_s)  # per-position evaluation
     bloom_size = 1 << bloom_log2
 
     def local_step(data):  # data: [Wl, Sl] — this shard's slice
@@ -213,6 +213,6 @@ def chunk_hash_block(data, *, block_len: int = 64 * 1024,
     core behind it (``_single_chip_step``) is what ``__graft_entry__.entry``
     exposes for the driver's compile check."""
     return _single_chip_step(
-        jnp.asarray(data), block_len=block_len, mask_s=params.mask_s,
+        jnp.asarray(data), block_len=block_len, mask_s=params.dense_mask_s,
         seed=params.seed,
     )

@@ -113,20 +113,25 @@ class MeshChunkHasher:
 
     # -- kernel 1: CDC candidates -------------------------------------------
 
-    def _cand_fn(self, shard_len: int, cap: int):
-        key = (shard_len, cap)
+    def _cand_fn(self, key):
         fn = self._cand_cache.get(key)
         if fn is None:
-            fn = _build_cand_fn(self.mesh, self.params, shard_len, cap)
+            if isinstance(key, tuple) and key[0] == "aligned":
+                fn = _build_cand_aligned_fn(self.mesh, self.params,
+                                            key[1], key[2])
+            else:
+                fn = _build_cand_fn(self.mesh, self.params, *key)
             self._cand_cache[key] = fn
         return fn
 
     def _candidates(self, data, shard_len: int, length: int):
+        if self.params.align > 1:
+            return self._candidates_aligned(data, shard_len, length)
         # Expected strict-candidate density is 2^-(bits+norm); 1/64 bytes
         # covers any mask down to 2^-6 (same bound as DeviceChunkHasher).
         cap = max(_pow2ceil(shard_len // 64, 1024), 1024)
         while True:
-            idx_s, cnt_s, idx_l, cnt_l = self._cand_fn(shard_len, cap)(
+            idx_s, cnt_s, idx_l, cnt_l = self._cand_fn((shard_len, cap))(
                 data, np.int32(length))
             cnt_s = np.asarray(cnt_s)
             cnt_l = np.asarray(cnt_l)
@@ -143,6 +148,31 @@ class MeshChunkHasher:
         out_l = np.concatenate([idx_l[i, : int(cnt_l[i])]
                                 for i in range(self.n_shards)])
         return out_s, out_l
+
+    def _candidates_aligned(self, data, shard_len: int, length: int):
+        """Aligned cuts need NO halo: the gear window at an eligible
+        position sits inside one align-byte row, which never crosses a
+        shard seam (shard_len % align == 0) — the collective disappears
+        and each shard compacts its own row lanes."""
+        cap = 1024
+        while True:
+            pos, flags, cnt = self._cand_fn(("aligned", shard_len, cap))(
+                data, np.int32(length))
+            cnt = np.asarray(cnt)
+            worst = int(cnt.max())
+            if worst <= cap:
+                break
+            cap = _pow2ceil(worst, cap * 2)
+        pos = np.asarray(pos)
+        flags = np.asarray(flags)
+        out_l = []
+        out_s = []
+        for i in range(self.n_shards):
+            n = int(cnt[i])
+            p = pos[i, :n]
+            out_l.append(p)
+            out_s.append(p[flags[i, :n]])
+        return np.concatenate(out_s), np.concatenate(out_l)
 
     # -- kernel 2: Merkle leaf digests --------------------------------------
 
@@ -242,6 +272,43 @@ def _build_cand_fn(mesh, params: GearParams, shard_len: int, cap: int):
         local, mesh=mesh,
         in_specs=(P(SEQ, None), P()),
         out_specs=(P(SEQ, None), P(SEQ), P(SEQ, None), P(SEQ)),
+    )
+    return jax.jit(sharded)
+
+
+def _build_cand_aligned_fn(mesh, params: GearParams, shard_len: int,
+                           cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.ops.gearcdc import gear_at_aligned
+
+    align = params.align
+    mask_s = np.uint32(params.mask_s)
+    mask_l = np.uint32(params.mask_l)
+    R = shard_len // align
+
+    def local(data, valid_len):  # data: [1, Ls]
+        i = jax.lax.axis_index(SEQ)
+        h = gear_at_aligned(data[0], params.seed, align)  # [R], no halo
+        pos = (i * shard_len
+               + jnp.arange(R, dtype=jnp.int32) * align + (align - 1))
+        ok = pos < valid_len
+        is_s = ((h & mask_s) == 0) & ok
+        is_l = ((h & mask_l) == 0) & ok
+        ridx = jnp.nonzero(is_l, size=cap, fill_value=R)[0]
+        safe = jnp.clip(ridx, 0, R - 1)
+        flags = jnp.where(ridx < R, is_s[safe], False)
+        out_pos = (i * shard_len + ridx.astype(jnp.int32) * align
+                   + (align - 1))
+        return out_pos[None], flags[None], jnp.sum(is_l)[None]
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P()),
+        out_specs=(P(SEQ, None), P(SEQ, None), P(SEQ)),
     )
     return jax.jit(sharded)
 
