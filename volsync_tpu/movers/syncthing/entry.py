@@ -1,0 +1,394 @@
+"""syncthing mover data plane: the always-on live-sync daemon.
+
+The /entry.sh analogue (mover-syncthing/entry.sh:65-138 seeds config and
+execs the vendored syncthing binary). Here the daemon itself is part of
+the framework: it block-hashes its folder on the TPU (engine/chunker
+hash_spans), serves a control API for the operator (the :8384 REST
+analogue, authenticated by the generated API key), exchanges file
+indexes with configured peer devices over the mutually-authenticated
+device transport (the :22000 BEP analogue), and converges the folder via
+version-vectors with last-writer-wins conflict resolution.
+
+Persistence: the device's file index (with version counters and deletion
+tombstones) lives in the config volume, exactly what the reference's
+config PVC holds for syncthing's database.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import socket
+import stat as stat_mod
+import threading
+import time
+from pathlib import Path
+
+from volsync_tpu.movers.rsync.channel import ChannelError, serve_session
+from volsync_tpu.movers.syncthing import transport
+
+log = logging.getLogger("volsync_tpu.mover.syncthing")
+
+_SCAN_INTERVAL = 0.2      # local rescan cadence (in-process substrate)
+_SYNC_INTERVAL = 0.3      # peer reconnect/pull cadence
+_PULL_CHUNK = 4 * 1024 * 1024
+
+
+def _hash_file(path: Path) -> str:
+    """Device-batched digest of one file (the per-block SHA-256 the
+    vendored syncthing does on CPU — here engine/chunker's device path)."""
+    from volsync_tpu.engine.chunker import hash_file_streaming, hash_spans
+
+    size = path.stat().st_size
+    if size > 32 * 1024 * 1024:
+        return hash_file_streaming(path)
+    data = path.read_bytes()
+    return hash_spans(data, [(0, len(data))])[0] if data else ""
+
+
+class FolderIndex:
+    """Versioned folder state: {rel: entry} with monotonic version
+    counters and deletion tombstones, persisted in the config volume."""
+
+    def __init__(self, store_path: Path, device: str):
+        self.path = store_path
+        self.device = device
+        self.lock = threading.RLock()
+        self.entries: dict = {}
+        self.max_version = 0
+        if store_path.is_file():
+            payload = json.loads(store_path.read_text())
+            self.entries = payload.get("entries", {})
+            self.max_version = payload.get("max_version", 0)
+
+    def save(self):
+        with self.lock:
+            tmp = self.path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(
+                {"entries": self.entries, "max_version": self.max_version}))
+            tmp.replace(self.path)
+
+    def bump(self) -> int:
+        self.max_version += 1
+        return self.max_version
+
+    def observe(self, remote_version: int):
+        """Lamport merge: local counters always move past anything seen."""
+        self.max_version = max(self.max_version, remote_version)
+
+    def scan(self, root: Path) -> bool:
+        """Rescan the folder; returns True if anything changed."""
+        with self.lock:
+            changed = False
+            seen = set()
+            for dirpath, dirnames, filenames in os.walk(root):
+                d = Path(dirpath)
+                for name in filenames + list(dirnames):
+                    p = d / name
+                    rel = p.relative_to(root).as_posix()
+                    st = p.lstat()
+                    seen.add(rel)
+                    cur = self.entries.get(rel)
+                    if stat_mod.S_ISDIR(st.st_mode):
+                        ent = {"type": "dir", "mode": st.st_mode & 0o7777}
+                    elif stat_mod.S_ISLNK(st.st_mode):
+                        ent = {"type": "symlink", "target": os.readlink(p)}
+                    elif stat_mod.S_ISREG(st.st_mode):
+                        if (cur and cur.get("type") == "file"
+                                and not cur.get("deleted")
+                                and cur["size"] == st.st_size
+                                and cur["mtime_ns"] == st.st_mtime_ns):
+                            continue  # unchanged: keep version + digest
+                        ent = {"type": "file", "size": st.st_size,
+                               "mtime_ns": st.st_mtime_ns,
+                               "mode": st.st_mode & 0o7777,
+                               "digest": _hash_file(p)}
+                    else:
+                        continue
+                    if (cur is None or cur.get("deleted")
+                            or {k: cur.get(k) for k in ent} != ent):
+                        self.entries[rel] = {
+                            **ent, "version": self.bump(),
+                            "modified_by": self.device, "deleted": False}
+                        changed = True
+            for rel, ent in list(self.entries.items()):
+                if rel not in seen and not ent.get("deleted"):
+                    self.entries[rel] = {
+                        "type": ent["type"], "deleted": True,
+                        "version": self.bump(), "modified_by": self.device}
+                    changed = True
+            if changed:
+                self.save()
+            return changed
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {rel: dict(e) for rel, e in self.entries.items()}
+
+
+class SyncthingDaemon:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.data = Path(ctx.mounts["data"])
+        self.config_dir = Path(ctx.mounts["config"])
+        sec = ctx.secrets["secret"]
+        self.apikey = sec["apikey"]
+        self.private = sec["cert"]
+        self.my_id = transport.device_id_from_private(self.private)
+        self.index = FolderIndex(self.config_dir / "index.json", self.my_id)
+        cfg_path = self.config_dir / "config.json"
+        self.config = (json.loads(cfg_path.read_text())
+                       if cfg_path.is_file() else {"devices": []})
+        self.cfg_path = cfg_path
+        self.cfg_lock = threading.RLock()
+        self.connected: dict[str, float] = {}  # device id -> last-seen ts
+        self.started = time.time()
+
+    # -- config ------------------------------------------------------------
+
+    def put_config(self, config: dict):
+        with self.cfg_lock:
+            self.config = {"devices": list(config.get("devices", []))}
+            tmp = self.cfg_path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(self.config))
+            tmp.replace(self.cfg_path)
+
+    def peer_devices(self) -> list:
+        with self.cfg_lock:
+            return [d for d in self.config.get("devices", [])
+                    if d.get("id") != self.my_id]
+
+    def known_ids(self):
+        return {d["id"] for d in self.peer_devices()}
+
+    # -- control API (the :8384 REST analogue) ------------------------------
+
+    def _control_verbs(self):
+        def get_config(msg):
+            with self.cfg_lock:
+                return {"verb": "ok", "config": self.config}
+
+        def put_config(msg):
+            self.put_config(msg.get("config") or {})
+            return {"verb": "ok"}
+
+        def get_status(msg):
+            return {"verb": "ok", "myID": self.my_id,
+                    "uptime": time.time() - self.started}
+
+        def get_connections(msg):
+            now = time.time()
+            return {"verb": "ok", "connections": {
+                d["id"]: {"connected":
+                          now - self.connected.get(d["id"], 0) < 5.0,
+                          "address": d.get("address", "")}
+                for d in self.peer_devices()}}
+
+        return {"get_config": get_config, "put_config": put_config,
+                "get_status": get_status,
+                "get_connections": get_connections}
+
+    # -- device protocol (the :22000 BEP analogue) ---------------------------
+
+    def _device_verbs(self, peer_id: str):
+        def index(msg):
+            # Receiving a peer's index piggybacks on their pull loop;
+            # we just return ours (both sides pull what they need).
+            return {"verb": "ok", "index": self.index.snapshot()}
+
+        def pull(msg):
+            rel = msg.get("rel", "")
+            off = int(msg.get("offset", 0))
+            p = (self.data / rel).resolve()
+            if not str(p).startswith(str(self.data.resolve())):
+                raise ChannelError("path escape")
+            try:
+                with open(p, "rb") as f:
+                    f.seek(off)
+                    piece = f.read(_PULL_CHUNK)
+            except OSError:
+                return {"verb": "gone"}
+            return {"verb": "ok", "data": piece,
+                    "eof": len(piece) < _PULL_CHUNK}
+
+        return {"index": index, "pull": pull}
+
+    # -- sync loop ----------------------------------------------------------
+
+    def _pull_file(self, ch, rel: str, ent: dict, tmp_root: Path) -> bool:
+        tmp = tmp_root / f".volsync-st-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            off = 0
+            while True:
+                ch.send({"verb": "pull", "rel": rel, "offset": off})
+                reply = ch.recv()
+                if reply.get("verb") != "ok":
+                    tmp.unlink(missing_ok=True)
+                    return False
+                piece = reply.get("data", b"")
+                f.write(piece)
+                off += len(piece)
+                if reply.get("eof"):
+                    break
+        target = self.data / rel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        tmp.replace(target)
+        os.chmod(target, ent.get("mode", 0o644))
+        os.utime(target, ns=(ent["mtime_ns"], ent["mtime_ns"]))
+        return True
+
+    def _apply_remote(self, ch, remote_index: dict) -> int:
+        """Adopt every remote entry that is strictly newer (version, then
+        device-id tiebreak — last-writer-wins)."""
+        applied = 0
+        for rel, rent in sorted(remote_index.items()):
+            with self.index.lock:
+                local = self.index.entries.get(rel)
+                self.index.observe(rent["version"])
+                if local is not None:
+                    if (local["version"], local["modified_by"]) >= (
+                            rent["version"], rent["modified_by"]):
+                        continue
+                target = self.data / rel
+                if rent.get("deleted"):
+                    if target.is_dir() and not target.is_symlink():
+                        import shutil
+
+                        shutil.rmtree(target, ignore_errors=True)
+                    else:
+                        target.unlink(missing_ok=True)
+                    self.index.entries[rel] = dict(rent)
+                    applied += 1
+                    continue
+                if rent["type"] == "dir":
+                    target.mkdir(parents=True, exist_ok=True)
+                    os.chmod(target, rent.get("mode", 0o755))
+                elif rent["type"] == "symlink":
+                    if target.is_symlink() or target.exists():
+                        target.unlink()
+                    target.parent.mkdir(parents=True, exist_ok=True)
+                    os.symlink(rent["target"], target)
+                elif rent["type"] == "file":
+                    if not self._pull_file(ch, rel, rent, self.data):
+                        continue
+                self.index.entries[rel] = dict(rent)
+                applied += 1
+        if applied:
+            self.index.save()
+        return applied
+
+    def _sync_with(self, dev: dict):
+        addr = dev.get("address", "")
+        if not addr.startswith("tcp://"):
+            return
+        host, _, port = addr[len("tcp://"):].rpartition(":")
+        try:
+            ch = transport.connect_device(host, int(port), self.private,
+                                          dev["id"], timeout=5.0)
+        except (OSError, ChannelError, ValueError):
+            self.connected.pop(dev["id"], None)
+            return
+        try:
+            ch.send({"verb": "index"})
+            reply = ch.recv()
+            self.connected[dev["id"]] = time.time()
+            self._apply_remote(ch, reply.get("index", {}))
+            ch.send({"verb": "shutdown", "rc": 0})
+            ch.recv()
+        except (OSError, ChannelError):
+            pass
+        finally:
+            ch.close()
+
+    # -- servers ------------------------------------------------------------
+
+    def _serve(self, server: socket.socket, handler):
+        server.settimeout(0.2)
+        while not self.ctx.stop_event.is_set():
+            try:
+                conn, _ = server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=handler, args=(conn,),
+                             daemon=True).start()
+        server.close()
+
+    def _handle_control(self, conn):
+        serve_session(conn, self.apikey, self._control_verbs())
+
+    def _handle_device(self, conn):
+        out = transport.accept_device(conn, self.private, self.known_ids())
+        if out is None:
+            return
+        ch, peer_id = out
+        self.connected[peer_id] = time.time()
+        verbs = self._device_verbs(peer_id)
+        try:
+            while True:
+                msg = ch.recv()
+                verb = msg.get("verb")
+                if verb == "shutdown":
+                    ch.send({"verb": "ok"})
+                    return
+                handler = verbs.get(verb)
+                if handler is None:
+                    return
+                ch.send(handler(msg))
+        except (ChannelError, OSError):
+            pass
+        finally:
+            ch.close()
+
+    def _publish_port(self, env_key: str, port: int):
+        svc_name = self.ctx.env.get(env_key)
+        if not svc_name or self.ctx.cluster is None:
+            return
+        svc = self.ctx.cluster.try_get("Service", self.ctx.namespace,
+                                       svc_name)
+        if svc is not None:
+            svc.status.bound_port = port
+            svc.status.cluster_ip = "127.0.0.1"
+            self.ctx.cluster.update_status(svc)
+
+    def run(self) -> int:
+        api_srv = socket.create_server(("127.0.0.1", 0))
+        data_srv = socket.create_server(("127.0.0.1", 0))
+        self._publish_port("SERVICE_API", api_srv.getsockname()[1])
+        self._publish_port("SERVICE_DATA", data_srv.getsockname()[1])
+        log.info("syncthing daemon %s api=%d data=%d", self.my_id[:12],
+                 api_srv.getsockname()[1], data_srv.getsockname()[1])
+        threading.Thread(target=self._serve,
+                         args=(api_srv, self._handle_control),
+                         daemon=True, name="st-api").start()
+        threading.Thread(target=self._serve,
+                         args=(data_srv, self._handle_device),
+                         daemon=True, name="st-data").start()
+        last_scan = 0.0
+        last_sync = 0.0
+        while not self.ctx.stop_event.is_set():
+            now = time.monotonic()
+            if now - last_scan >= _SCAN_INTERVAL:
+                try:
+                    self.index.scan(self.data)
+                except OSError as e:
+                    log.warning("scan failed: %s", e)
+                last_scan = now
+            if now - last_sync >= _SYNC_INTERVAL:
+                for dev in self.peer_devices():
+                    self._sync_with(dev)
+                last_sync = now
+            self.ctx.stop_event.wait(0.05)
+        return 0
+
+
+def syncthing_entrypoint(ctx) -> int:
+    for required in ("SERVICE_API", "SERVICE_DATA"):
+        if required not in ctx.env:
+            log.error("missing env %s (entry.sh preflight analogue)",
+                      required)
+            return 2
+    return SyncthingDaemon(ctx).run()
