@@ -133,6 +133,30 @@ def test_mesh_sync_and_status(world):
     assert cluster.get("Secret", "default", "volsync-st-alpha") is not None
 
 
+def test_type_change_converges(world):
+    """A path that changes TYPE (dir -> file) must still converge: the
+    apply clears the conflicting old object instead of wedging the peer
+    round (dir->file collisions raise without _clear_conflict)."""
+    cluster = world
+    _wire_mesh(cluster)
+    root_a = _vol_root(cluster, "alpha")
+    d = root_a / "thing"
+    d.mkdir()
+    (d / "inner.txt").write_bytes(b"inner")
+    wait(cluster, lambda: (
+        _vol_root(cluster, "beta") / "thing" / "inner.txt").is_file())
+    # Replace the directory with a regular FILE of the same name.
+    import shutil
+
+    shutil.rmtree(d)
+    d.write_bytes(b"now a file")
+    for other in ("beta", "gamma"):
+        wait(cluster, lambda o=other: (
+            (_vol_root(cluster, o) / "thing").is_file()
+            and (_vol_root(cluster, o) / "thing").read_bytes()
+            == b"now a file"))
+
+
 def test_unknown_device_is_refused(world, tmp_path):
     """The daemon's pinned-ID trust model: a device NOT in its config
     cannot complete the handshake (the reference refuses unknown certs)."""

@@ -109,13 +109,16 @@ def cleanup_objects(cluster: Cluster, owner,
 
 
 def ensure_service_account(cluster: Cluster, owner, name: str,
-                           runner_policy: str = DEFAULT_RUNNER_POLICY,
+                           runner_policy: Optional[str] = None,
                            ) -> ServiceAccount:
     """Per-CR mover identity: ServiceAccount + Role granting ``use`` of
     the runner policy + RoleBinding tying them together — the full
     sahandler.go:38-153 triple (SA, Role with use-SCC rule :47-55,
     RoleBinding :56-62), with the SCC name replaced by the runner-policy
-    name."""
+    name. The default resolves at CALL time so the operator's --scc-name
+    flag (which reassigns DEFAULT_RUNNER_POLICY) takes effect."""
+    if runner_policy is None:
+        runner_policy = DEFAULT_RUNNER_POLICY
     ns = owner.metadata.namespace
     sa = ServiceAccount(metadata=ObjectMeta(name=name, namespace=ns))
     set_owned_by(sa, owner, cluster)

@@ -37,31 +37,46 @@ class SyncthingConnection:
         self.apikey = apikey
         self.timeout = timeout
 
-    def _call(self, verb: str, **payload) -> dict:
-        ch = client_connect(self.address, self.port, self.apikey,
-                            timeout=self.timeout)
-        try:
-            ch.send({"verb": verb, **payload})
-            reply = ch.recv()
-            if reply.get("verb") != "ok":
-                raise ChannelError(f"{verb} failed: {reply}")
-            ch.send({"verb": "shutdown", "rc": 0})
-            ch.recv()
-            return reply
-        finally:
-            ch.close()
+    def _session(self):
+        return client_connect(self.address, self.port, self.apikey,
+                              timeout=self.timeout)
+
+    @staticmethod
+    def _call(ch, verb: str, **payload) -> dict:
+        ch.send({"verb": verb, **payload})
+        reply = ch.recv()
+        if reply.get("verb") != "ok":
+            raise ChannelError(f"{verb} failed: {reply}")
+        return reply
+
+    @staticmethod
+    def _end(ch):
+        ch.send({"verb": "shutdown", "rc": 0})
+        ch.recv()
 
     def fetch(self) -> SyncthingState:
-        """GET config + system status + connections (connection.go:37-61)."""
-        config = self._call("get_config")["config"]
-        status = self._call("get_status")
-        conns = self._call("get_connections")["connections"]
+        """GET config + system status + connections in ONE session
+        (connection.go:37-61 issues three requests per Fetch; the sealed
+        channel serves them all without re-handshaking)."""
+        ch = self._session()
+        try:
+            config = self._call(ch, "get_config")["config"]
+            status = self._call(ch, "get_status")
+            conns = self._call(ch, "get_connections")["connections"]
+            self._end(ch)
+        finally:
+            ch.close()
         return SyncthingState(config=config, my_id=status["myID"],
                               connections=conns)
 
     def publish_config(self, config: dict) -> None:
         """PUT /rest/config (connection.go:65-73)."""
-        self._call("put_config", config=config)
+        ch = self._session()
+        try:
+            self._call(ch, "put_config", config=config)
+            self._end(ch)
+        finally:
+            ch.close()
 
 
 def try_fetch(address: str, port: int,

@@ -38,7 +38,10 @@ from volsync_tpu.movers import base
 from volsync_tpu.movers.base import Result
 from volsync_tpu.movers.common import mover_name
 from volsync_tpu.movers.syncthing import transport
-from volsync_tpu.movers.syncthing.apiclient import try_fetch
+from volsync_tpu.movers.syncthing.apiclient import (
+    SyncthingConnection,
+    try_fetch,
+)
 
 MOVER_NAME = "syncthing"
 DEFAULT_CONFIG_CAPACITY = 1 * 1024 * 1024 * 1024  # 1Gi config volume
@@ -88,7 +91,7 @@ class SyncthingMover:
         if state is None:
             return Result.retry(timedelta(seconds=min(self.poll_seconds, 1)))
 
-        self._ensure_is_configured(state, secret)
+        self._ensure_is_configured(state, secret, api_addr, api_port)
         self._update_status(state, data_svc, secret)
         # Always-on mover: never "completed" — re-poll on a cadence.
         return Result.retry(timedelta(seconds=self.poll_seconds))
@@ -204,7 +207,7 @@ class SyncthingMover:
              for p in self.spec.peers if p.id != my_id),
             key=lambda d: d["id"])
 
-    def _ensure_is_configured(self, state, secret):
+    def _ensure_is_configured(self, state, secret, api_addr, api_port):
         """Diff the live device list against spec.peers and publish when
         they differ (ensureIsConfigured :673-720 + updateSyncthingDevices
         syncthing.go:32-119)."""
@@ -212,18 +215,9 @@ class SyncthingMover:
         current = sorted(state.config.get("devices", []),
                          key=lambda d: d.get("id", ""))
         if current != desired:
-            api_addr, api_port = self._service_endpoint(
-                self.cluster.get(
-                    "Service", self.owner.metadata.namespace,
-                    mover_name("st-api", self.owner)))
-            if api_addr is not None:
-                from volsync_tpu.movers.syncthing.apiclient import (
-                    SyncthingConnection,
-                )
-
-                SyncthingConnection(
-                    api_addr, api_port, secret.data["apikey"],
-                ).publish_config({"devices": desired})
+            SyncthingConnection(
+                api_addr, api_port, secret.data["apikey"],
+            ).publish_config({"devices": desired})
 
     def _update_status(self, state, data_svc, secret):
         """ID + data address + per-peer connectivity

@@ -25,6 +25,7 @@ import stat as stat_mod
 import threading
 import time
 from pathlib import Path
+from typing import Optional
 
 from volsync_tpu.movers.rsync.channel import ChannelError, serve_session
 from volsync_tpu.movers.syncthing import transport
@@ -34,6 +35,11 @@ log = logging.getLogger("volsync_tpu.mover.syncthing")
 _SCAN_INTERVAL = 0.2      # local rescan cadence (in-process substrate)
 _SYNC_INTERVAL = 0.3      # peer reconnect/pull cadence
 _PULL_CHUNK = 4 * 1024 * 1024
+#: In-flight pull temp files live in the data folder (same filesystem, so
+#: the final rename is atomic) under this prefix, which the scanner and
+#: the pull verb both exclude — a crash mid-pull must never replicate a
+#: partial file.
+_TMP_PREFIX = ".volsync-st-"
 
 
 def _hash_file(path: Path) -> str:
@@ -79,13 +85,23 @@ class FolderIndex:
         self.max_version = max(self.max_version, remote_version)
 
     def scan(self, root: Path) -> bool:
-        """Rescan the folder; returns True if anything changed."""
+        """Rescan the folder; returns True if anything changed.
+
+        Hashing runs OUTSIDE the lock (a multi-GB new file must not
+        stall the device-protocol index handler); the lock is retaken to
+        commit, re-stat-ing each hashed file so a write that raced the
+        hash is simply picked up by the next scan instead of being
+        recorded with a stale digest.
+        """
+        changed = False
+        to_hash: list[tuple[str, Path, object]] = []
         with self.lock:
-            changed = False
             seen = set()
             for dirpath, dirnames, filenames in os.walk(root):
                 d = Path(dirpath)
                 for name in filenames + list(dirnames):
+                    if name.startswith(_TMP_PREFIX):
+                        continue  # crash-leftover pull temp: never index
                     p = d / name
                     rel = p.relative_to(root).as_posix()
                     st = p.lstat()
@@ -101,10 +117,8 @@ class FolderIndex:
                                 and cur["size"] == st.st_size
                                 and cur["mtime_ns"] == st.st_mtime_ns):
                             continue  # unchanged: keep version + digest
-                        ent = {"type": "file", "size": st.st_size,
-                               "mtime_ns": st.st_mtime_ns,
-                               "mode": st.st_mode & 0o7777,
-                               "digest": _hash_file(p)}
+                        to_hash.append((rel, p, st))
+                        continue
                     else:
                         continue
                     if (cur is None or cur.get("deleted")
@@ -119,9 +133,36 @@ class FolderIndex:
                         "type": ent["type"], "deleted": True,
                         "version": self.bump(), "modified_by": self.device}
                     changed = True
+
+        digests: dict[str, str] = {}
+        for rel, p, _ in to_hash:          # slow part, unlocked
+            try:
+                digests[rel] = _hash_file(p)
+            except OSError:
+                pass  # vanished/changing mid-hash: next scan retries
+
+        with self.lock:
+            for rel, p, st in to_hash:
+                if rel not in digests:
+                    continue
+                try:
+                    now = p.lstat()
+                except OSError:
+                    continue
+                if (now.st_size != st.st_size
+                        or now.st_mtime_ns != st.st_mtime_ns
+                        or not stat_mod.S_ISREG(now.st_mode)):
+                    continue  # raced a writer; next scan re-hashes
+                self.entries[rel] = {
+                    "type": "file", "size": st.st_size,
+                    "mtime_ns": st.st_mtime_ns,
+                    "mode": st.st_mode & 0o7777, "digest": digests[rel],
+                    "version": self.bump(),
+                    "modified_by": self.device, "deleted": False}
+                changed = True
             if changed:
                 self.save()
-            return changed
+        return changed
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -202,8 +243,10 @@ class SyncthingDaemon:
             rel = msg.get("rel", "")
             off = int(msg.get("offset", 0))
             p = (self.data / rel).resolve()
-            if not str(p).startswith(str(self.data.resolve())):
+            if not p.is_relative_to(self.data.resolve()):
                 raise ChannelError("path escape")
+            if p.name.startswith(_TMP_PREFIX):
+                return {"verb": "gone"}
             try:
                 with open(p, "rb") as f:
                     f.seek(off)
@@ -217,8 +260,12 @@ class SyncthingDaemon:
 
     # -- sync loop ----------------------------------------------------------
 
-    def _pull_file(self, ch, rel: str, ent: dict, tmp_root: Path) -> bool:
-        tmp = tmp_root / f".volsync-st-{os.getpid()}"
+    def _fetch_to_temp(self, ch, rel: str) -> Optional[Path]:
+        """Stream a remote file into an excluded temp in the data folder
+        (same filesystem -> the later rename is atomic). Runs OUTSIDE the
+        index lock: a transfer can take a while and must not block the
+        scanner or the index handler serving other peers."""
+        tmp = self.data / f"{_TMP_PREFIX}{os.getpid()}-{threading.get_ident()}"
         with open(tmp, "wb") as f:
             off = 0
             while True:
@@ -226,33 +273,56 @@ class SyncthingDaemon:
                 reply = ch.recv()
                 if reply.get("verb") != "ok":
                     tmp.unlink(missing_ok=True)
-                    return False
+                    return None
                 piece = reply.get("data", b"")
                 f.write(piece)
                 off += len(piece)
                 if reply.get("eof"):
-                    break
-        target = self.data / rel
-        target.parent.mkdir(parents=True, exist_ok=True)
-        tmp.replace(target)
-        os.chmod(target, ent.get("mode", 0o644))
-        os.utime(target, ns=(ent["mtime_ns"], ent["mtime_ns"]))
-        return True
+                    return tmp
+
+    @staticmethod
+    def _clear_conflict(target: Path, want: str):
+        """A path that changed TYPE (dir->file, file->dir, anything<->
+        symlink) must have the old object removed first, or the apply
+        raises and wedges the whole peer round. Symlinks are always
+        re-created fresh (os.symlink cannot overwrite)."""
+        import shutil
+
+        if target.is_symlink():
+            if want != "file":  # rename-over replaces a symlink entry fine
+                target.unlink()
+        elif target.is_dir():
+            if want != "dir":
+                shutil.rmtree(target, ignore_errors=True)
+        elif target.exists():
+            if want in ("dir", "symlink"):
+                target.unlink()
+
+    def _newer_than_local(self, rel: str, rent: dict) -> bool:
+        local = self.index.entries.get(rel)
+        self.index.observe(rent["version"])
+        if local is None:
+            return True
+        return (local["version"], local["modified_by"]) < (
+            rent["version"], rent["modified_by"])
 
     def _apply_remote(self, ch, remote_index: dict) -> int:
         """Adopt every remote entry that is strictly newer (version, then
-        device-id tiebreak — last-writer-wins)."""
+        device-id tiebreak — last-writer-wins). File contents transfer
+        outside the index lock; the lock is retaken only for the final
+        rename+record (re-checking the version, in case a concurrent
+        local write won meanwhile)."""
         applied = 0
         for rel, rent in sorted(remote_index.items()):
             with self.index.lock:
-                local = self.index.entries.get(rel)
-                self.index.observe(rent["version"])
-                if local is not None:
-                    if (local["version"], local["modified_by"]) >= (
-                            rent["version"], rent["modified_by"]):
+                if not self._newer_than_local(rel, rent):
+                    continue
+            target = self.data / rel
+            if rent.get("deleted"):
+                with self.index.lock:
+                    if not self._newer_than_local(rel, rent):
                         continue
-                target = self.data / rel
-                if rent.get("deleted"):
+                    self._clear_conflict(target, "absent")
                     if target.is_dir() and not target.is_symlink():
                         import shutil
 
@@ -261,22 +331,48 @@ class SyncthingDaemon:
                         target.unlink(missing_ok=True)
                     self.index.entries[rel] = dict(rent)
                     applied += 1
+                continue
+            if rent["type"] == "file":
+                tmp = self._fetch_to_temp(ch, rel)   # slow part, unlocked
+                if tmp is None:
+                    continue
+                # Verify content against the advertised digest BEFORE
+                # installing: a pull that raced a live writer on the
+                # remote (torn read) must be discarded, not recorded
+                # under the remote's metadata — a same-size in-place
+                # rewrite would otherwise never be rescanned.
+                if rent.get("digest") and _hash_file(tmp) != rent["digest"]:
+                    tmp.unlink(missing_ok=True)
+                    continue  # remote is mid-write; next round re-pulls
+                with self.index.lock:
+                    if not self._newer_than_local(rel, rent):
+                        tmp.unlink(missing_ok=True)
+                        continue
+                    target.parent.mkdir(parents=True, exist_ok=True)
+                    self._clear_conflict(target, "file")
+                    tmp.replace(target)
+                    os.chmod(target, rent.get("mode", 0o644))
+                    os.utime(target,
+                             ns=(rent["mtime_ns"], rent["mtime_ns"]))
+                    self.index.entries[rel] = dict(rent)
+                    applied += 1
+                continue
+            with self.index.lock:
+                if not self._newer_than_local(rel, rent):
                     continue
                 if rent["type"] == "dir":
+                    self._clear_conflict(target, "dir")
                     target.mkdir(parents=True, exist_ok=True)
                     os.chmod(target, rent.get("mode", 0o755))
                 elif rent["type"] == "symlink":
-                    if target.is_symlink() or target.exists():
-                        target.unlink()
+                    self._clear_conflict(target, "symlink")
                     target.parent.mkdir(parents=True, exist_ok=True)
                     os.symlink(rent["target"], target)
-                elif rent["type"] == "file":
-                    if not self._pull_file(ch, rel, rent, self.data):
-                        continue
                 self.index.entries[rel] = dict(rent)
                 applied += 1
         if applied:
-            self.index.save()
+            with self.index.lock:
+                self.index.save()
         return applied
 
     def _sync_with(self, dev: dict):
@@ -330,6 +426,10 @@ class SyncthingDaemon:
         try:
             while True:
                 msg = ch.recv()
+                if peer_id not in self.known_ids():
+                    # Removed from the live config mid-session: revoke
+                    # immediately, not just at the next handshake.
+                    return
                 verb = msg.get("verb")
                 if verb == "shutdown":
                     ch.send({"verb": "ok"})
