@@ -67,10 +67,15 @@ def test_replication_end_to_end_via_cli(world, rng):
     rc, out = _cli(contexts, tmp_path, ["replication", "sync", "rel1"])
     assert rc == 0, out
 
-    # The destination cluster holds a synced latestImage snapshot.
+    # The destination cluster holds a synced latestImage snapshot (its
+    # reconcile publishes the image asynchronously after the listener
+    # Job completes).
     dst = contexts["destination"]
+    assert dst.wait_for(lambda: (
+        (rd := dst.try_get("ReplicationDestination", "default", "dest"))
+        and rd.status and rd.status.latest_image is not None),
+        timeout=30, poll=0.1)
     rd = dst.get("ReplicationDestination", "default", "dest")
-    assert rd.status.latest_image is not None
     snap = dst.get("VolumeSnapshot", "default", rd.status.latest_image.name)
     restored = pathlib.Path(snap.status.bound_content)
     for rel, content in files.items():

@@ -1,0 +1,141 @@
+"""The network data plane: mover-jax gRPC service, cross-process rsync,
+and the asymmetric key split.
+
+Covers VERDICT r2 item 5's done-conditions: an rsync e2e across TWO OS
+processes via a real network address, and a gRPC client getting
+(boundaries, digests) for a streamed buffer, identical to local chunking.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from volsync_tpu.movers import devicetransport as dt
+from volsync_tpu.ops.gearcdc import GearParams
+from volsync_tpu.service import MoverJaxClient, MoverJaxServer
+
+PARAMS = GearParams(min_size=4096, avg_size=16384, max_size=65536)
+
+
+@pytest.fixture(scope="module")
+def service():
+    with MoverJaxServer(params=PARAMS, segment_size=256 * 1024) as srv:
+        yield srv
+
+
+def test_chunk_stream_matches_local(service, rng):
+    """The north-star contract: a remote stream chunks bit-identically
+    to a local scan of the same bytes."""
+    from volsync_tpu.engine.chunker import DeviceChunkHasher
+
+    data = rng.bytes(1_200_000)
+    with MoverJaxClient("127.0.0.1", service.port, service.token) as client:
+        remote = client.chunk_bytes(data)
+    local = DeviceChunkHasher(PARAMS).process(
+        np.frombuffer(data, np.uint8))
+    assert remote == local
+    assert b"".join(data[o: o + l] for o, l, _ in remote) == data
+
+
+def test_streaming_segmentation_is_invisible(service, rng):
+    """Feeding the stream in awkward piece sizes must not change
+    boundaries (the carry-the-tail protocol)."""
+    data = rng.bytes(700_001)
+    with MoverJaxClient("127.0.0.1", service.port, service.token) as client:
+        whole = client.chunk_bytes(data)
+        pos = [0]
+
+        def dribble(n):
+            piece = data[pos[0]: pos[0] + min(n, 37_777)]
+            pos[0] += len(piece)
+            return piece
+
+        dribbled = list(client.chunk_stream(dribble))
+    assert dribbled == whole
+
+
+def test_hash_spans_and_info(service, rng):
+    from volsync_tpu.repo import blobid
+
+    blobs = [b"", b"x", rng.bytes(5000), rng.bytes(70_000)]
+    buf = b"".join(blobs)
+    spans, off = [], 0
+    for b in blobs:
+        spans.append((off, len(b)))
+        off += len(b)
+    with MoverJaxClient("127.0.0.1", service.port, service.token) as client:
+        got = client.hash_spans(buf, spans)
+        info = client.info()
+    assert got == [blobid.blob_id(b) for b in blobs]
+    assert info.avg_size == PARAMS.avg_size
+    assert info.align == PARAMS.align
+
+
+def test_bad_token_unauthenticated(service):
+    import grpc
+
+    with MoverJaxClient("127.0.0.1", service.port, "wrong") as client:
+        with pytest.raises(grpc.RpcError) as ei:
+            client.info()
+    assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+
+def test_rsync_across_two_processes(tmp_path, rng):
+    """A REAL second OS process runs the standalone destination listener
+    on a network address; this process pushes a tree into it with the
+    source half of the key split (the destination's private key never
+    present here)."""
+    from volsync_tpu.movers.rsync.entry import _push_tree
+
+    src_priv = dt.generate_device_key()
+    dst_priv = dt.generate_device_key()
+    dest_root = tmp_path / "dest"
+    dest_root.mkdir()
+    key_file = tmp_path / "dst.key"
+    key_file.write_bytes(dst_priv)
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "volsync_tpu.movers.rsync.standalone",
+         "--root", str(dest_root), "--key-file", str(key_file),
+         "--source-id", dt.device_id_from_private(src_priv),
+         "--bind", "127.0.0.1", "--port", "0"],
+        stdout=subprocess.PIPE, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": "/root/repo",
+             "JAX_PLATFORMS": "cpu", "HOME": str(tmp_path)},
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+
+        src_root = tmp_path / "src"
+        (src_root / "sub").mkdir(parents=True)
+        files = {"a.bin": rng.bytes(120_000), "sub/b.txt": b"beta" * 999}
+        for rel, content in files.items():
+            (src_root / rel).write_bytes(content)
+
+        # A WRONG device must be refused at handshake.
+        stranger = dt.generate_device_key()
+        from volsync_tpu.movers.rsync.channel import ChannelError
+
+        with pytest.raises(ChannelError):
+            dt.connect_device("127.0.0.1", port, stranger,
+                              dt.device_id_from_private(dst_priv),
+                              timeout=3.0)
+
+        ch = dt.connect_device("127.0.0.1", port, src_priv,
+                               dt.device_id_from_private(dst_priv))
+        stats = _push_tree(ch, src_root)
+        ch.send({"verb": "shutdown", "rc": 0})
+        ch.recv()
+        ch.close()
+        assert stats["files"] == 2
+        assert proc.wait(timeout=10) == 0  # exit code = transferred rc
+        for rel, content in files.items():
+            assert (dest_root / rel).read_bytes() == content
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -37,8 +37,14 @@ class MigrationCLI(ContextCLI):
                timeout: float = 60.0) -> dict:
         """RD with Direct copy into the (possibly new) destination volume
         — a migration wants the bytes in the PVC itself, not a snapshot
-        chain (migration_create.go)."""
-        rel = Relationship.create(self.config_dir, name, TYPE_MIGRATION)
+        chain (migration_create.go).
+
+        The relationship file persists only after the cluster side is
+        ready: a failed create leaves nothing on disk, so it can simply
+        be re-run (cluster objects are cleaned up on failure)."""
+        rel = Relationship(self.config_dir, name, TYPE_MIGRATION)
+        if rel.path.exists():
+            raise RelationshipError(f"relationship {name!r} already exists")
         cl = self._cluster(cluster)
         rd = ReplicationDestination(
             metadata=ObjectMeta(name=f"volsync-mig-{name}",
@@ -70,6 +76,10 @@ class MigrationCLI(ContextCLI):
             lambda: self._rd_ready(cl, namespace, f"volsync-mig-{name}"),
             timeout=timeout, poll=0.1)
         if not ok:
+            # Roll back the labeled objects so a retry starts clean.
+            for kind in ("ReplicationDestination", "Volume"):
+                for obj in cl.list(kind, namespace, labels=rel.label()):
+                    cl.delete(kind, namespace, obj.metadata.name)
             raise RelationshipError(
                 "migration destination did not publish address/keys")
         fresh = cl.get("ReplicationDestination", namespace,
@@ -90,7 +100,7 @@ class MigrationCLI(ContextCLI):
         """LOCAL push: pull the connection key from the destination's
         Secret and delta-push ``source_dir`` from THIS process — the
         workstation-side transfer of migration_rsync.go:81-117."""
-        from volsync_tpu.movers.rsync import channel
+        from volsync_tpu.movers import devicetransport as dt
         from volsync_tpu.movers.rsync.entry import _push_tree
 
         rel = Relationship.load(self.config_dir, name, TYPE_MIGRATION)
@@ -99,8 +109,9 @@ class MigrationCLI(ContextCLI):
             raise RelationshipError("run migration create first")
         cl = self._cluster(dest["cluster"])
         secret = cl.get("Secret", dest["namespace"], dest["keys_secret"])
-        ch = channel.client_connect(dest["address"], dest["port"],
-                                    secret.data["key"])
+        ch = dt.connect_device(dest["address"], dest["port"],
+                               secret.data["source"],
+                               secret.data["destination-id"].decode())
         try:
             stats = _push_tree(ch, Path(source_dir))
             ch.send({"verb": "shutdown", "rc": 0})

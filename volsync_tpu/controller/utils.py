@@ -115,10 +115,13 @@ def ensure_service_account(cluster: Cluster, owner, name: str,
     the runner policy + RoleBinding tying them together — the full
     sahandler.go:38-153 triple (SA, Role with use-SCC rule :47-55,
     RoleBinding :56-62), with the SCC name replaced by the runner-policy
-    name. The default resolves at CALL time so the operator's --scc-name
-    flag (which reassigns DEFAULT_RUNNER_POLICY) takes effect."""
+    name. The default resolves at CALL time, preferring the cluster
+    handle's ``runner_policy`` (set from the operator's --scc-name flag,
+    per cluster so co-resident operator runtimes don't clobber each
+    other) over the module default."""
     if runner_policy is None:
-        runner_policy = DEFAULT_RUNNER_POLICY
+        runner_policy = getattr(cluster, "runner_policy", None) \
+            or DEFAULT_RUNNER_POLICY
     ns = owner.metadata.namespace
     sa = ServiceAccount(metadata=ObjectMeta(name=name, namespace=ns))
     set_owned_by(sa, owner, cluster)

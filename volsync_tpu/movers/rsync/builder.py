@@ -28,7 +28,13 @@ from volsync_tpu.movers.base import Result
 from volsync_tpu.movers.common import mover_name, reconcile_job
 
 MOVER_NAME = "rsync"
-KEY_FIELDS = ("key",)
+#: Source-facing secret fields: the SOURCE's private device key + the
+#: destination's pinned device ID. The destination's private key never
+#: leaves its own secret — the reference's 3-secret asymmetry
+#: (rsync_common.go:104-128: main/src/dst split so neither side holds
+#: the other's private key).
+SRC_KEY_FIELDS = ("source", "destination-id")
+DST_KEY_FIELDS = ("destination", "source-id")
 
 
 @dataclasses.dataclass
@@ -57,15 +63,17 @@ class RsyncDestinationMover:
             dest = vh.ensure_new_volume(dest_name)
             if dest is None:
                 return Result.in_progress()
-        secret = self._ensure_keys()
-        st.rsync.ssh_keys = secret.metadata.name
+        dst_secret, src_secret = self._ensure_keys()
+        # Publish the SOURCE-facing half (the reference publishes the
+        # source secret's name in .status.rsync.sshKeys the same way).
+        st.rsync.ssh_keys = src_secret.metadata.name
         svc = self._ensure_service()
         job = reconcile_job(
             self.cluster, self.owner, mover_name("dst", self.owner),
             entrypoint="rsync-destination",
             env={"SERVICE": svc.metadata.name},
             volumes={"data": dest.metadata.name},
-            secrets={"keys": secret.metadata.name},
+            secrets={"keys": dst_secret.metadata.name},
             backoff_limit=2, paused=self.paused, metrics=self.metrics,
             node_selector=utils.affinity_from_volume(
                 self.cluster, ns, dest.metadata.name),
@@ -99,21 +107,55 @@ class RsyncDestinationMover:
                               kinds=("Job", "VolumeSnapshot", "Volume"))
         return Result.complete()
 
-    def _ensure_keys(self) -> Secret:
-        name = self.spec.ssh_keys or mover_name("dst-keys", self.owner)
-        existing = self.cluster.try_get(
-            "Secret", self.owner.metadata.namespace, name)
-        if existing is not None:
-            utils.get_and_validate_secret(
-                self.cluster, self.owner.metadata.namespace, name, KEY_FIELDS)
-            return existing
-        secret = Secret(
-            metadata=ObjectMeta(name=name,
-                                namespace=self.owner.metadata.namespace),
-            data={"key": os.urandom(32)},
+    def _ensure_keys(self) -> tuple[Secret, Secret]:
+        """Generate the asymmetric key split (rsync_common.go:104-219's
+        ssh-keygen + 3-secret scheme, with DH device keys): a MAIN secret
+        holding both private keys (kept, like the reference's main
+        secret), a DESTINATION secret (dest private + source's pinned
+        device ID) mounted by the listener Job, and a SOURCE secret
+        (source private + destination's pinned ID) whose name is
+        published in status for the operator/CLI to copy to the source
+        cluster. Returns (dst_secret, src_secret)."""
+        from volsync_tpu.movers import devicetransport as dt
+
+        ns = self.owner.metadata.namespace
+        main_name = self.spec.ssh_keys or mover_name("dst-main", self.owner)
+        if self.spec.ssh_keys:
+            # User-supplied main secret: validate its shape up front so a
+            # wrong secret is a clean config error, not a KeyError.
+            utils.get_and_validate_secret(self.cluster, ns, main_name,
+                                          ("source", "destination"))
+        main = self.cluster.try_get("Secret", ns, main_name)
+        if main is None:
+            src_priv = dt.generate_device_key()
+            dst_priv = dt.generate_device_key()
+            main = Secret(
+                metadata=ObjectMeta(name=main_name, namespace=ns),
+                data={"source": src_priv, "destination": dst_priv},
+            )
+            utils.set_owned_by(main, self.owner, self.cluster)
+            main = self.cluster.create(main)
+        src_priv = main.data["source"]
+        dst_priv = main.data["destination"]
+        src_id = dt.device_id_from_private(src_priv).encode()
+        dst_id = dt.device_id_from_private(dst_priv).encode()
+
+        dst_secret = Secret(
+            metadata=ObjectMeta(name=mover_name("dst-keys", self.owner),
+                                namespace=ns),
+            data={"destination": dst_priv, "source-id": src_id},
         )
-        utils.set_owned_by(secret, self.owner, self.cluster)
-        return self.cluster.create(secret)
+        utils.set_owned_by(dst_secret, self.owner, self.cluster)
+        dst_secret = self.cluster.apply(dst_secret)
+
+        src_secret = Secret(
+            metadata=ObjectMeta(name=mover_name("src-keys", self.owner),
+                                namespace=ns),
+            data={"source": src_priv, "destination-id": dst_id},
+        )
+        utils.set_owned_by(src_secret, self.owner, self.cluster)
+        src_secret = self.cluster.apply(src_secret)
+        return dst_secret, src_secret
 
     def _ensure_service(self) -> Service:
         name = mover_name("dst", self.owner)
@@ -153,7 +195,7 @@ class RsyncSourceMover:
                 "spec.rsync.ssh_keys is required on the source "
                 "(the destination's key secret)")
         utils.get_and_validate_secret(self.cluster, ns, self.spec.ssh_keys,
-                                      KEY_FIELDS)
+                                      SRC_KEY_FIELDS)
         st.rsync.ssh_keys = self.spec.ssh_keys
         vh = VolumeHandler.from_volume_options(self.cluster, self.owner,
                                                self.spec)

@@ -89,24 +89,19 @@ def client_connect(address: str, port: int, key: bytes,
     return ch
 
 
-def serve_session(conn: socket.socket, key: bytes,
-                  verbs: dict[str, Callable[[dict], dict]],
-                  timeout: float = 30.0) -> Optional[int]:
-    """Serve one authenticated session. ``verbs`` maps verb name ->
-    handler(msg)->reply. Returns the rc passed to the ``shutdown`` verb,
-    or None if the peer just disconnected. Unknown verbs and MAC failures
-    terminate the session immediately (forced-command discipline)."""
-    conn.settimeout(timeout)
-    ch = Framed(conn, box_from_key(key))
+def serve_channel(ch: Framed,
+                  verbs: dict[str, Callable[[dict], dict]]) -> Optional[int]:
+    """Serve verbs over an ALREADY-authenticated channel (PSK hello or
+    the device-transport DH handshake). Returns the rc passed to the
+    ``shutdown`` verb, or None if the peer just disconnected. Unknown
+    verbs terminate the session (forced-command discipline)."""
     try:
-        hello = ch.recv()  # MAC-validated: proves the client holds the key
-        if hello.get("verb") != "hello":
-            return None
-        ch.send({"verb": "hello-ack", "nonce": hello.get("nonce")})
         while True:
             try:
                 msg = ch.recv()
-            except ChannelError:
+            except (ChannelError, OSError):
+                # Includes socket.timeout: a stalled peer drops ITS
+                # session; the listener's accept loop must survive.
                 return None
             verb = msg.get("verb")
             if verb == "shutdown":
@@ -118,3 +113,22 @@ def serve_session(conn: socket.socket, key: bytes,
             ch.send(handler(msg))
     finally:
         ch.close()
+
+
+def serve_session(conn: socket.socket, key: bytes,
+                  verbs: dict[str, Callable[[dict], dict]],
+                  timeout: float = 30.0) -> Optional[int]:
+    """Serve one PSK-authenticated session. ``verbs`` maps verb name ->
+    handler(msg)->reply; MAC failures terminate immediately."""
+    conn.settimeout(timeout)
+    ch = Framed(conn, box_from_key(key))
+    try:
+        hello = ch.recv()  # MAC-validated: proves the client holds the key
+        if hello.get("verb") != "hello":
+            ch.close()
+            return None
+        ch.send({"verb": "hello-ack", "nonce": hello.get("nonce")})
+    except ChannelError:
+        ch.close()
+        return None
+    return serve_channel(ch, verbs)

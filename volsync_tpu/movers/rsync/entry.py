@@ -93,9 +93,50 @@ def _dest_verbs(root: Path):
             "symlink": symlink, "prune": prune}
 
 
+def serve_destination(root: Path, dst_private: bytes, source_id: str,
+                      *, bind: str = "127.0.0.1", preferred_port: int = 0,
+                      stop_event=None, on_port=None) -> int:
+    """The listener proper: accept device-authenticated sessions from the
+    pinned source device and serve the sync verb table until the source's
+    ``shutdown <rc>`` arrives; that rc becomes the exit code, exactly like
+    the forced-command sshd wrapper (destination.sh:19-27).
+
+    ``bind`` un-loopbacks the listener for cross-host deployment
+    (BIND_ADDRESS env in the mover contract; the standalone listener
+    binds 0.0.0.0)."""
+    from volsync_tpu.movers import devicetransport as dt
+
+    try:
+        server = socket.create_server((bind, preferred_port))
+    except OSError:
+        server = socket.create_server((bind, 0))
+    port = server.getsockname()[1]
+    if on_port is not None:
+        on_port(port)
+    log.info("rsync destination listening on %s:%d", bind, port)
+    server.settimeout(0.5)
+    verbs = _dest_verbs(Path(root))
+    try:
+        while stop_event is None or not stop_event.is_set():
+            try:
+                conn, _ = server.accept()
+            except socket.timeout:
+                continue
+            out = dt.accept_device(conn, dst_private, {source_id})
+            if out is None:
+                continue  # unknown/failed device: refused at handshake
+            ch, _peer = out
+            rc = channel.serve_channel(ch, verbs)
+            if rc is not None:  # source sent shutdown <rc>
+                return rc
+        return 1  # stopped without a completed transfer
+    finally:
+        server.close()
+
+
 def rsync_destination_entrypoint(ctx) -> int:
     root = ctx.mounts["data"]
-    key = ctx.secrets["keys"]["key"]
+    keys = ctx.secrets["keys"]
     # Reuse the previously-published port so the address the source was
     # configured with stays valid across sync iterations (the reference's
     # Service port is stable for the same reason); fall back to an
@@ -106,26 +147,11 @@ def rsync_destination_entrypoint(ctx) -> int:
         svc = ctx.cluster.try_get("Service", ctx.namespace, svc_name)
         if svc is not None and svc.status.bound_port:
             preferred = svc.status.bound_port
-    try:
-        server = socket.create_server(("127.0.0.1", preferred))
-    except OSError:
-        server = socket.create_server(("127.0.0.1", 0))
-    port = server.getsockname()[1]
-    _publish_port(ctx, port)
-    log.info("rsync destination listening on %d", port)
-    server.settimeout(0.5)
-    verbs = _dest_verbs(Path(root))
-    while not ctx.stop_event.is_set():
-        try:
-            conn, _ = server.accept()
-        except socket.timeout:
-            continue
-        rc = channel.serve_session(conn, key, verbs)
-        if rc is not None:  # source sent shutdown <rc> (destination.sh:19-27)
-            server.close()
-            return rc
-    server.close()
-    return 1  # stopped without a completed transfer
+    return serve_destination(
+        Path(root), keys["destination"], keys["source-id"].decode(),
+        bind=ctx.env.get("BIND_ADDRESS", "127.0.0.1"),
+        preferred_port=preferred, stop_event=ctx.stop_event,
+        on_port=lambda port: _publish_port(ctx, port))
 
 
 def _publish_port(ctx, port: int):
@@ -149,8 +175,12 @@ def _publish_port(ctx, port: int):
 
 
 def rsync_source_entrypoint(ctx) -> int:
+    from volsync_tpu.movers import devicetransport as dt
+
     root = Path(ctx.mounts["data"])
-    key = ctx.secrets["keys"]["key"]
+    keys = ctx.secrets["keys"]
+    src_private = keys["source"]
+    dest_id = keys["destination-id"].decode()
     address = ctx.env["ADDRESS"]
     port = int(ctx.env["PORT"])
 
@@ -160,7 +190,9 @@ def rsync_source_entrypoint(ctx) -> int:
         if ctx.stop_event.is_set():
             return 1
         try:
-            ch = channel.client_connect(address, port, key)
+            # Mutual device auth: we pin the destination's ID, it pins
+            # ours — neither side ever held the other's private key.
+            ch = dt.connect_device(address, port, src_private, dest_id)
             try:
                 t0 = time.perf_counter()
                 stats = _push_tree(ch, root)

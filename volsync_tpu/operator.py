@@ -90,10 +90,14 @@ class OperatorRuntime:
         self._owns_storage = not cfg.get("storage_path")
         storage_path = cfg.get("storage_path") or tempfile.mkdtemp(
             prefix="volsync-operator-")
-        utils.DEFAULT_RUNNER_POLICY = cfg.get("scc_name", "volsync-mover")
 
         self.config = cfg
         self.cluster = Cluster(storage=StorageProvider(Path(storage_path)))
+        # Per-CLUSTER setting (ensure_service_account reads it off the
+        # cluster handle): a process-global would let co-resident
+        # runtimes clobber each other's policy.
+        self.cluster.runner_policy = cfg.get("scc_name",
+                                             utils.DEFAULT_RUNNER_POLICY)
         self.catalog = Catalog()
         self.runner_catalog = EntrypointCatalog()
         self.metrics = Metrics()

@@ -1,0 +1,168 @@
+"""mover-jax gRPC server: the TPU chunk/hash engine as a network service.
+
+The BASELINE.json north star: where the reference's movers exec a wrapped
+binary inside the pod, remote movers here call a gRPC service whose hot
+loops run on the accelerator (engine/chunker.py). Service surface:
+
+- ``ChunkHash``  — bidirectional stream: volume bytes in segments ->
+  finalized (offset, length, blob id) chunks, streaming-CDC semantics
+  bit-identical to local chunking (the carry-the-tail protocol of
+  stream_chunks).
+- ``HashSpans``  — batched span digests (the rclone checksum primitive).
+- ``Info``       — engine/backend/chunker-envelope discovery.
+
+Security keeps the reference's envelope (mutually-known secret +
+restricted verb surface — rsync_common.go's keyed channel): every call
+must carry the service token in ``x-volsync-token`` metadata; anything
+else is UNAUTHENTICATED. The method table is closed — gRPC generic
+handlers register exactly these three methods.
+
+Service stubs are hand-wired over protoc-generated messages
+(grpc_tools is not vendored; grpc's generic-handler API needs only the
+message classes).
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from volsync_tpu.service import moverjax_pb2 as pb
+
+log = logging.getLogger("volsync_tpu.moverjax")
+
+SERVICE_NAME = "moverjax.MoverJax"
+TOKEN_METADATA_KEY = "x-volsync-token"
+
+#: Stream segmentation mirrors engine/chunker.stream_chunks: a segment is
+#: processed once at least this much beyond max_size is buffered.
+DEFAULT_SEGMENT_SIZE = 32 * 1024 * 1024
+
+
+class _TokenInterceptor(grpc.ServerInterceptor):
+    def __init__(self, token: str):
+        self._token = token.encode()
+        self._deny = grpc.unary_unary_rpc_method_handler(self._refuse)
+
+    def _refuse(self, request, context):
+        context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad service token")
+
+    def intercept_service(self, continuation, handler_call_details):
+        meta = dict(handler_call_details.invocation_metadata)
+        supplied = str(meta.get(TOKEN_METADATA_KEY, "")).encode()
+        if not hmac.compare_digest(supplied, self._token):
+            return self._deny
+        return continuation(handler_call_details)
+
+
+class MoverJaxServer:
+    """One engine, many remote movers. ``token`` is the shared service
+    secret (generated if not supplied — read it back via ``.token``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None, params=None,
+                 segment_size: int = DEFAULT_SEGMENT_SIZE,
+                 max_workers: int = 8):
+        from volsync_tpu.engine.chunker import DeviceChunkHasher
+        from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS
+
+        self.params = params or DEFAULT_PARAMS
+        self.segment_size = segment_size
+        self.token = token or os.urandom(32).hex()
+        self._hasher = DeviceChunkHasher(self.params)
+
+        serialize = lambda m: m.SerializeToString()  # noqa: E731
+        handlers = {
+            "ChunkHash": grpc.stream_stream_rpc_method_handler(
+                self._chunk_hash, pb.DataSegment.FromString, serialize),
+            "HashSpans": grpc.unary_unary_rpc_method_handler(
+                self._hash_spans, pb.HashSpansRequest.FromString, serialize),
+            "Info": grpc.unary_unary_rpc_method_handler(
+                self._info, pb.InfoRequest.FromString, serialize),
+        }
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=[_TokenInterceptor(self.token)],
+        )
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),
+        ))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "MoverJaxServer":
+        self._server.start()
+        log.info("mover-jax serving on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self, grace: float = 2.0):
+        self._server.stop(grace).wait()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- methods -------------------------------------------------------------
+
+    def _chunk_hash(self, request_iterator, context):
+        """Streaming CDC over the call: identical carry-the-tail protocol
+        to engine/chunker.stream_chunks, so a remote stream chunks
+        bit-identically to a local scan of the same bytes."""
+        pending = bytearray()  # amortized append; bytes += would be O(n^2)
+        base = 0
+        p = self.params
+
+        def flush(eof: bool) -> pb.ChunkBatch:
+            nonlocal base
+            out = self._hasher.process(
+                np.frombuffer(bytes(pending), np.uint8), eof=eof)
+            batch = pb.ChunkBatch(final=eof)
+            consumed = 0
+            for start, length, digest in out:
+                batch.chunks.append(pb.Chunk(
+                    offset=base + start, length=length, digest=digest))
+                consumed = start + length
+            base += consumed
+            del pending[:consumed]  # keep only the carried tail
+            return batch
+
+        for seg in request_iterator:
+            if seg.data:
+                pending += seg.data
+            while len(pending) >= self.segment_size + p.max_size:
+                yield flush(False)
+            if seg.eof:
+                yield flush(True)
+                return
+        # Stream ended without an eof marker: finalize what we have
+        # (client disconnect mid-stream just drops the call).
+        yield flush(True)
+
+    def _hash_spans(self, request: pb.HashSpansRequest, context):
+        from volsync_tpu.engine.chunker import hash_spans
+
+        spans = [(s.offset, s.length) for s in request.spans]
+        for off, length in spans:
+            if off + length > len(request.data):
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "span out of range")
+        return pb.HashSpansResponse(
+            digests=hash_spans(request.data, spans))
+
+    def _info(self, request: pb.InfoRequest, context):
+        import jax
+
+        return pb.InfoResponse(
+            backend=jax.default_backend(),
+            min_size=self.params.min_size, avg_size=self.params.avg_size,
+            max_size=self.params.max_size, align=self.params.align)
