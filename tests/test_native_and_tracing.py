@@ -1,0 +1,148 @@
+"""Native IO runtime (C++ volio) + tracing subsystem (A1).
+
+The reference's native code lives inside vendored binaries; ours is the
+runtime around the device kernels: golden-tested against the Python
+reference implementations, with graceful fallback when disabled.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from volsync_tpu.io import ReadaheadReader, available, \
+    select_boundaries_native
+from volsync_tpu.obs import reset_spans, span, span_totals
+from volsync_tpu.ops.gearcdc import GearParams, _select_boundaries_py
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native toolchain unavailable")
+
+PARAMS = GearParams(min_size=256, avg_size=1024, max_size=4096)
+
+
+def test_readahead_reader_streams_exactly(tmp_path, rng):
+    p = tmp_path / "f.bin"
+    data = rng.bytes(3_000_001)
+    p.write_bytes(data)
+    got = b""
+    with ReadaheadReader(p, 256 * 1024) as r:
+        while True:
+            piece = r.read(99_991)  # awkward read size vs segment size
+            if not piece:
+                break
+            got += piece
+    assert got == data
+
+
+def test_readahead_empty_and_exact_multiple(tmp_path, rng):
+    empty = tmp_path / "empty"
+    empty.write_bytes(b"")
+    with ReadaheadReader(empty, 4096) as r:
+        assert r.read(100) == b""
+    exact = tmp_path / "exact"
+    payload = rng.bytes(8192)  # exactly 2 segments
+    exact.write_bytes(payload)
+    with ReadaheadReader(exact, 4096) as r:
+        assert r.read(10_000) == payload
+        assert r.read(1) == b""
+
+
+def test_native_walk_matches_python_reference(rng):
+    for trial in range(5):
+        length = int(rng.randint(10_000, 300_000))
+        n_l = int(rng.randint(0, 200))
+        idx_l = np.sort(rng.choice(length, size=n_l,
+                                   replace=False)).astype(np.int64)
+        idx_s = idx_l[rng.rand(n_l) < 0.3].copy()
+        for eof in (True, False):
+            want = _select_boundaries_py(idx_s, idx_l, length, PARAMS,
+                                         eof=eof, base=1000)
+            got = select_boundaries_native(idx_s, idx_l, length, PARAMS,
+                                           eof, base=1000)
+            assert got == want, (trial, eof)
+
+
+def test_native_walk_pathological():
+    # no candidates at all: forced max cuts
+    empty = np.asarray([], dtype=np.int64)
+    want = _select_boundaries_py(empty, empty, 20_000, PARAMS, eof=True)
+    got = select_boundaries_native(empty, empty, 20_000, PARAMS, True)
+    assert got == want
+    lengths = {l for _, l in got[:-1]}
+    assert lengths == {PARAMS.max_size}
+
+
+def test_backup_through_native_reader(tmp_path, rng):
+    """TreeBackup's large-file path rides the readahead reader; the
+    snapshot must be identical to a plain-read backup."""
+    from volsync_tpu.engine import TreeBackup
+    from volsync_tpu.objstore import FsObjectStore
+    from volsync_tpu.repo.repository import Repository
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "big.bin").write_bytes(rng.bytes(2_000_000))
+
+    def mk(name):
+        return Repository.init(FsObjectStore(tmp_path / name), password="x",
+                               chunker={"min_size": 4096, "avg_size": 16384,
+                                        "max_size": 65536,
+                                        "seed": 1, "align": 64})
+
+    snap_native, _ = TreeBackup(mk("r-native")).run(src)
+    os.environ["VOLSYNC_NO_NATIVE"] = "1"
+    try:
+        # the loader caches; NO_NATIVE affects only fresh processes for
+        # the library, but the reader fallback path checks available()
+        # lazily per call through TreeBackup._open_stream -> this still
+        # exercises the plain-open fallback branch via monkeypatching
+        import volsync_tpu.engine.backup as backup_mod
+
+        # Save the raw descriptor: attribute access unwraps staticmethod,
+        # and restoring the bare function would turn it into a bound
+        # method for every later test.
+        orig = backup_mod.TreeBackup.__dict__["_open_stream"]
+        backup_mod.TreeBackup._open_stream = staticmethod(
+            lambda path: open(path, "rb"))
+        try:
+            snap_plain, _ = TreeBackup(mk("r-plain")).run(src)
+        finally:
+            backup_mod.TreeBackup._open_stream = orig
+    finally:
+        del os.environ["VOLSYNC_NO_NATIVE"]
+
+    r1 = Repository.open(FsObjectStore(tmp_path / "r-native"), password="x")
+    r2 = Repository.open(FsObjectStore(tmp_path / "r-plain"), password="x")
+    t1 = dict(r1.list_snapshots())[snap_native]["tree"]
+    t2 = dict(r2.list_snapshots())[snap_plain]["tree"]
+    assert t1 == t2
+
+
+def test_spans_record_and_export(rng):
+    reset_spans()
+    with span("test.stage"):
+        pass
+    with span("test.stage"):
+        pass
+    totals = span_totals()
+    assert totals["test.stage"][0] == 2
+    # the histogram rides the global metrics registry
+    from volsync_tpu.metrics import GLOBAL
+
+    body = GLOBAL.expose().decode()
+    assert "volsync_stage_duration_seconds" in body
+    assert 'stage="test.stage"' in body
+
+
+def test_engine_emits_spans(rng):
+    from volsync_tpu.engine.chunker import DeviceChunkHasher
+
+    reset_spans()
+    params = GearParams(min_size=4096, avg_size=16384, max_size=65536)
+    buf = np.frombuffer(rng.bytes(300_000), np.uint8)
+    DeviceChunkHasher(params).process(buf)
+    totals = span_totals()
+    assert totals.get("engine.candidates", (0,))[0] >= 1
+    assert totals.get("engine.boundary_walk", (0,))[0] >= 1
+    assert totals.get("engine.leaf_fetch_assemble", (0,))[0] >= 1

@@ -167,9 +167,24 @@ class TreeBackup:
             digest = blobid.blob_id(data)
             self.repo.add_blob(BLOB_DATA, digest, data, stats)
             return [digest]
-        with open(path, "rb") as f:
-            for chunk, digest in stream_chunks(f.read, self.params,
+        # Large files stream through the native readahead reader when
+        # available (native/volio.cpp): disk IO for segment N+1 overlaps
+        # the device hashing of segment N (plain open() fallback).
+        reader_cm = self._open_stream(path)
+        with reader_cm as reader:
+            for chunk, digest in stream_chunks(reader.read, self.params,
                                                hasher=self.hasher):
                 self.repo.add_blob(BLOB_DATA, digest, chunk, stats)
                 content.append(digest)
         return content
+
+    @staticmethod
+    def _open_stream(path: Path):
+        try:
+            from volsync_tpu.io import ReadaheadReader, available
+
+            if available():
+                return ReadaheadReader(path, 32 * 1024 * 1024)
+        except Exception:  # noqa: BLE001 — native is optional
+            pass
+        return open(path, "rb")

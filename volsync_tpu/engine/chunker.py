@@ -104,9 +104,13 @@ class DeviceChunkHasher:
 
     def begin_device(self, dev, length: int, *,
                      eof: bool = True) -> "PendingSegment":
+        from volsync_tpu.obs import span
+
         p = self.params
-        idx_s, idx_l = self._candidates(dev, length)
-        chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
+        with span("engine.candidates"):
+            idx_s, idx_l = self._candidates(dev, length)
+        with span("engine.boundary_walk"):
+            chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
         if not chunks:
             return PendingSegment([], None, None)
         if p.align >= 64:
@@ -277,9 +281,12 @@ class PendingSegment:
     def finish(self) -> list[tuple[int, int, str]]:
         if self._done is not None:
             return self._done
+        from volsync_tpu.obs import span
+
         (plan, (dev_digests, lanes_f)) = self._inflight
-        hexes = _assemble_roots(self.chunks, plan,
-                                np.asarray(dev_digests), lanes_f)
+        with span("engine.leaf_fetch_assemble"):
+            hexes = _assemble_roots(self.chunks, plan,
+                                    np.asarray(dev_digests), lanes_f)
         self._done = [(int(s), int(l), h)
                       for (s, l), h in zip(self.chunks, hexes)]
         self._inflight = None

@@ -128,8 +128,12 @@ def _dispatch(ctx, env: dict, direction: str) -> int:
             return 0
         repo = _open_or_init(env)
         t0 = time.perf_counter()
-        snap_id, stats = TreeBackup(repo, hasher=_select_hasher(env, repo)).run(
-            data, hostname=env.get("HOSTNAME", "volsync"))
+        from volsync_tpu.obs import device_trace, span
+
+        with device_trace("restic-backup"), span("mover.restic.backup"):
+            snap_id, stats = TreeBackup(
+                repo, hasher=_select_hasher(env, repo)).run(
+                data, hostname=env.get("HOSTNAME", "volsync"))
         log.info("backup snapshot=%s stats=%s", snap_id, stats.as_dict())
         ctx.report_transfer(stats.bytes_scanned, time.perf_counter() - t0)
         # Maintenance after a durable snapshot must not fail the sync: a

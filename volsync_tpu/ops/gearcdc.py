@@ -275,7 +275,28 @@ def select_boundaries(idx_s: np.ndarray, idx_l: np.ndarray, length: int,
 
     If ``eof`` is False the tail (which might extend into the next segment)
     is not emitted; the caller resumes from the returned position.
+
+    Dispatches to the native C walk (native/volio.cpp) when the library
+    is available; ``_select_boundaries_py`` is the reference
+    implementation, and the golden tests pin their equality.
     """
+    try:
+        from volsync_tpu.io.native import select_boundaries_native
+
+        out = select_boundaries_native(idx_s, idx_l, length, params,
+                                       eof, base)
+        if out is not None:
+            return out
+    except Exception:  # noqa: BLE001 — native is an accelerator, not a dep
+        pass
+    return _select_boundaries_py(idx_s, idx_l, length, params, eof=eof,
+                                 base=base)
+
+
+def _select_boundaries_py(idx_s: np.ndarray, idx_l: np.ndarray, length: int,
+                          params: GearParams, *, eof: bool = True,
+                          base: int = 0) -> list[tuple[int, int]]:
+    """Pure-Python reference walk (see select_boundaries)."""
     chunks: list[tuple[int, int]] = []
     pos = 0
     while pos < length:
