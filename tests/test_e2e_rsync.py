@@ -79,6 +79,8 @@ def test_rsync_push_roundtrip_and_delta(world, rng):
     cr = cluster.get("ReplicationDestination", "default", "dst")
     address, port = cr.status.rsync.address, cr.status.rsync.port
     keys = cr.status.rsync.ssh_keys
+    assert any(e.reason == "ServiceAddressAssigned"
+               for e in cluster.events_for(cr))
 
     rs = ReplicationSource(
         metadata=ObjectMeta(name="src", namespace="default"),

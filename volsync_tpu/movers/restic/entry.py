@@ -53,7 +53,13 @@ def _open_or_init(env: dict) -> Repository:
         repo = Repository.open(store, password=password)
     except RepoError:
         log.info("repository not initialized; creating (entry.sh:52-57)")
-        repo = Repository.init(store, password=password)
+        try:
+            repo = Repository.init(store, password=password)
+        except RepoError:
+            # Lost the init race to a concurrent mover sharing this
+            # repository: open the winner's (init is atomic, so the
+            # config is whole).
+            repo = Repository.open(store, password=password)
     # Wait out a concurrent holder instead of failing the sync on first
     # contention (shared repositories across CRs are supported).
     repo.default_lock_wait = float(env.get("LOCK_WAIT_SECONDS", "120"))

@@ -84,6 +84,13 @@ class RsyncDestinationMover:
         svc = self.cluster.get("Service", ns, svc.metadata.name)
         address = utils.get_service_address(svc)
         if address and svc.status.bound_port:
+            if st.rsync.address is None:
+                # First assignment (utils.go:86-100 + mover.go:158-175's
+                # address wait resolving): announce it.
+                self.cluster.record_event(
+                    self.owner, "Normal", "ServiceAddressAssigned",
+                    f"listener reachable at {address}:"
+                    f"{svc.status.bound_port}")
             st.rsync.address = address
             st.rsync.port = svc.status.bound_port
         else:

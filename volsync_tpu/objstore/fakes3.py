@@ -94,6 +94,10 @@ class FakeS3Server:
                 if not key:
                     return self._reply(200)  # CreateBucket
                 with outer._lock:
+                    if (self.headers.get("If-None-Match") == "*"
+                            and (bucket, key) in outer._objects):
+                        return self._reply(
+                            412, b"<Error>PreconditionFailed</Error>")
                     outer._objects[(bucket, key)] = body
                 self._reply(200)
 

@@ -228,6 +228,27 @@ class S3ObjectStore:
         if status not in (200, 201, 204):
             raise S3Error(status, body)
 
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        """Conditional PUT with If-None-Match: * (S3's native
+        create-if-absent; MinIO and AWS support it) — 412 means another
+        writer won the race.
+
+        Retry hazard: _request re-sends once on a dropped connection, so
+        if OUR first PUT committed server-side before the connection
+        died, the retry sees a 412 for our own object and this returns
+        False. Callers must treat False as "the key exists" (and read it
+        back) — NOT as "someone else's data is there"; don't build a
+        lock/lease on this primitive without an ETag check."""
+        _check_key(key)
+        status, _, body = self._request(
+            "PUT", key, body=bytes(data),
+            headers={"If-None-Match": "*"})
+        if status in (200, 201, 204):
+            return True
+        if status in (409, 412):
+            return False
+        raise S3Error(status, body)
+
     def get(self, key: str) -> bytes:
         status, _, body = self._request("GET", key)
         if status == 404:

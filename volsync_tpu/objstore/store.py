@@ -16,6 +16,10 @@ from typing import Iterator, Optional, Protocol
 
 class ObjectStore(Protocol):
     def put(self, key: str, data: bytes) -> None: ...
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic create-if-absent; False = the key already exists.
+        Required: Repository.init's no-clobber guarantee rests on it."""
+        ...
     def get(self, key: str) -> bytes: ...
     def get_range(self, key: str, offset: int, length: int) -> bytes: ...
     def exists(self, key: str) -> bool: ...
@@ -78,6 +82,23 @@ class FsObjectStore:
         tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
         tmp.write_bytes(data)
         tmp.rename(p)  # atomic visibility
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic create-if-absent (hard link fails if the target
+        exists): the primitive Repository.init uses so two movers racing
+        to initialize one repository can never clobber each other's
+        config/salt."""
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
+        tmp.write_bytes(data)
+        try:
+            os.link(tmp, p)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            tmp.unlink(missing_ok=True)
 
     def get(self, key: str) -> bytes:
         try:
@@ -154,6 +175,14 @@ class MemObjectStore:
         _check_key(key)
         with self._lock:
             self._objs[key] = bytes(data)
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        _check_key(key)
+        with self._lock:
+            if key in self._objs:
+                return False
+            self._objs[key] = bytes(data)
+            return True
 
     def get(self, key: str) -> bytes:
         with self._lock:

@@ -95,6 +95,11 @@ class Repository:
     @classmethod
     def init(cls, store: ObjectStore, password: Optional[str] = None,
              chunker: Optional[dict] = None) -> "Repository":
+        """Initialize a fresh repository. The config write is atomic
+        create-if-absent, so two movers racing to initialize one shared
+        repository can never clobber each other's config/salt (one wins,
+        the loser gets RepoError and opens the winner's repo — a silent
+        overwrite would make every earlier sealed object MAC-fail)."""
         if store.exists("config"):
             raise RepoError("repository already initialized")
         import os
@@ -117,7 +122,12 @@ class Repository:
             "salt": salt.hex() if salt else None,
             "verifier": box.seal(_VERIFIER_PLAINTEXT).hex() if password else None,
         }
-        store.put("config", json.dumps(config).encode())
+        payload = json.dumps(config).encode()
+        # put_if_absent is a hard ObjectStore requirement (no silent
+        # non-atomic fallback: that would quietly reintroduce the
+        # config-clobber race for a store that forgot to implement it).
+        if not store.put_if_absent("config", payload):
+            raise RepoError("repository already initialized")
         return cls(store, box, config)
 
     @classmethod
