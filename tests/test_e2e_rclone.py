@@ -235,3 +235,34 @@ def test_hostile_index_paths_rejected(tmp_path):
     with pytest.raises(SyncError, match="unsafe"):
         sync_down(store, "p", dst)
     assert not (tmp_path / "escape.txt").exists()
+
+
+def test_mirror_lease_blocks_concurrent_writers(tmp_path, rng):
+    """Two sources mirroring one prefix: the second writer is refused
+    while the lease is held (instead of silently sweeping the first's
+    objects), and a crashed holder's stale lease is stolen."""
+    import json
+    import time as time_mod
+
+    from volsync_tpu.movers.rclone import sync as sync_mod
+    from volsync_tpu.objstore import MemObjectStore
+
+    store = MemObjectStore()
+    root = tmp_path / "v"
+    root.mkdir()
+    (root / "f").write_bytes(rng.bytes(10_000))
+
+    with sync_mod._MirrorLease(store, "pfx"):
+        with pytest.raises(sync_mod.BucketLockedError):
+            sync_mod.sync_up(root, store, "pfx")
+    # released: the mirror proceeds
+    stats = sync_mod.sync_up(root, store, "pfx")
+    assert stats["files"] == 1
+
+    # stale lock (crashed holder) is swept; the sync proceeds
+    store.put(sync_mod._key("pfx", sync_mod.LOCKS, "dead.json"), json.dumps(
+        {"holder": "dead", "time": time_mod.time() - 3600}).encode())
+    stats = sync_mod.sync_up(root, store, "pfx")
+    assert stats["files"] == 1
+    # all lock objects released afterwards (own + swept stale)
+    assert list(store.list(sync_mod._key("pfx", sync_mod.LOCKS))) == []

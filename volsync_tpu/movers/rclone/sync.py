@@ -51,9 +51,93 @@ class SyncError(RuntimeError):
     pass
 
 
+class BucketLockedError(SyncError):
+    """Another writer holds the bucket prefix's mirror lease."""
+
+
 def _key(prefix: str, *parts: str) -> str:
     prefix = prefix.strip("/")
     return "/".join((prefix, *parts)) if prefix else "/".join(parts)
+
+
+LOCKS = "locks"
+LOCK_STALE_SECONDS = 10 * 60
+LOCK_REFRESH_SECONDS = LOCK_STALE_SECONDS / 3
+
+
+class _MirrorLease:
+    """Writer lease over one bucket prefix.
+
+    Two sources mirroring into one prefix would otherwise sweep each
+    other's objects (each's index only references its own files). The
+    protocol is the repository layer's restic-style one (see
+    repo/repository.py), which needs NO compare-and-swap from the store:
+    write your OWN uniquely-named lock object under ``<prefix>/locks/``,
+    then scan; any other fresh lock means back off (remove your own,
+    raise BucketLockedError — the Job's backoff machinery retries).
+    Crashed holders go stale after LOCK_STALE_SECONDS and are swept by
+    the next contender; LIVE holders re-stamp their lock every
+    LOCK_REFRESH_SECONDS from a heartbeat thread, so a long mirror is
+    never mistaken for a crash. Two simultaneous contenders can both
+    back off (safe, retried) — never both proceed.
+    """
+
+    def __init__(self, store: ObjectStore, prefix: str):
+        self.store = store
+        self.prefix = prefix
+        self.holder = f"{os.getpid()}-{os.urandom(4).hex()}"
+        self.key = _key(prefix, LOCKS, f"{self.holder}.json")
+        self._stop = None
+
+    def _stamp(self):
+        import time
+
+        self.store.put(self.key, json.dumps(
+            {"holder": self.holder, "time": time.time()}).encode())
+
+    def _others_fresh(self) -> list:
+        import time
+
+        fresh = []
+        for key in list(self.store.list(_key(self.prefix, LOCKS))):
+            if key == self.key:
+                continue
+            try:
+                held = json.loads(self.store.get(key))
+            except (NoSuchKey, ValueError):
+                continue
+            if time.time() - held.get("time", 0) > LOCK_STALE_SECONDS:
+                self.store.delete(key)  # crashed holder: sweep
+            else:
+                fresh.append(held.get("holder"))
+        return fresh
+
+    def __enter__(self):
+        import threading
+
+        self._stamp()
+        others = self._others_fresh()
+        if others:
+            self.store.delete(self.key)  # back off: only our own lock
+            raise BucketLockedError(
+                f"{self.prefix}: mirror held by {others}")
+        stop = threading.Event()
+        self._stop = stop
+
+        def heartbeat():
+            while not stop.wait(LOCK_REFRESH_SECONDS):
+                try:
+                    self._stamp()
+                except Exception:  # noqa: BLE001 — keep mirroring; the
+                    pass           # next beat retries the re-stamp
+        threading.Thread(target=heartbeat, daemon=True,
+                         name="mirror-lease").start()
+        return self
+
+    def __exit__(self, *exc):
+        if self._stop is not None:
+            self._stop.set()
+        self.store.delete(self.key)  # only ever our own lock object
 
 
 def _safe_rel(rel: str) -> bool:
@@ -165,6 +249,13 @@ def sync_up(root: Path, store: ObjectStore, prefix: str, *,
     for rel in files:
         entries[rel]["digest"] = digests[rel]
 
+    with _MirrorLease(store, prefix):
+        return _mirror_up(root, store, prefix, entries, files, digests,
+                          transfers)
+
+
+def _mirror_up(root, store, prefix, entries, files, digests,
+               transfers) -> dict:
     wanted = set(digests.values())
     have = {k.rsplit("/", 1)[-1] for k in store.list(_key(prefix, OBJECTS))}
     to_upload = wanted - have
