@@ -73,12 +73,17 @@ def _vol_root(cluster, name) -> pathlib.Path:
         cluster.get("Volume", "default", f"{name}-data").status.path)
 
 
-def _wire_mesh(cluster):
+def _spawn_peers(cluster) -> dict:
+    """Create all peers and wait for their published identities."""
     for name in NAMES:
         _mk_peer(cluster, name)
     for name in NAMES:
         wait(cluster, lambda n=name: _identity(cluster, n) is not None)
-    ids = {n: _identity(cluster, n) for n in NAMES}
+    return {n: _identity(cluster, n) for n in NAMES}
+
+
+def _wire_mesh(cluster):
+    ids = _spawn_peers(cluster)
     for name in NAMES:
         cr = cluster.get("ReplicationSource", "default", name)
         cr.spec.syncthing.peers = [
@@ -155,6 +160,46 @@ def test_type_change_converges(world):
             (_vol_root(cluster, o) / "thing").is_file()
             and (_vol_root(cluster, o) / "thing").read_bytes()
             == b"now a file"))
+
+
+def test_introducer_propagates_devices(world):
+    """Star topology: alpha and gamma each know ONLY beta (marked
+    introducer); beta knows both. Introduction teaches alpha and gamma
+    about each other (stamped introduced_by), and data still converges
+    across the full mesh (syncthing's introducer semantics)."""
+    cluster = world
+    ids = _spawn_peers(cluster)
+
+    hub = cluster.get("ReplicationSource", "default", "beta")
+    hub.spec.syncthing.peers = [
+        SyncthingPeer(address=ids[o].address, id=ids[o].id)
+        for o in ("alpha", "gamma")]
+    cluster.update(hub)
+    for spoke in ("alpha", "gamma"):
+        cr = cluster.get("ReplicationSource", "default", spoke)
+        cr.spec.syncthing.peers = [SyncthingPeer(
+            address=ids["beta"].address, id=ids["beta"].id,
+            introducer=True)]
+        cluster.update(cr)
+
+    # alpha learns gamma through beta (and vice versa).
+    def introduced(spoke, other):
+        cr = cluster.try_get("ReplicationSource", "default", spoke)
+        st = cr.status.syncthing if (cr and cr.status) else None
+        if not st:
+            return False
+        return any(p.id == ids[other].id
+                   and p.introduced_by == ids["beta"].id
+                   for p in st.peers)
+
+    wait(cluster, lambda: introduced("alpha", "gamma"))
+    wait(cluster, lambda: introduced("gamma", "alpha"))
+
+    # and the mesh converges end-to-end.
+    (_vol_root(cluster, "alpha") / "via-hub.txt").write_bytes(b"hello")
+    for other in ("beta", "gamma"):
+        wait(cluster, lambda o=other: (
+            (_vol_root(cluster, o) / "via-hub.txt").is_file()))
 
 
 def test_unknown_device_is_refused(world, tmp_path):

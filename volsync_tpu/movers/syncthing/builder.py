@@ -200,18 +200,27 @@ class SyncthingMover:
         return (address, fresh.status.bound_port) \
             if address and fresh.status.bound_port else (None, None)
 
-    def _desired_devices(self, my_id: str) -> list:
-        return sorted(
-            ({"id": p.id, "address": p.address,
-              "introducer": p.introducer}
-             for p in self.spec.peers if p.id != my_id),
-            key=lambda d: d["id"])
+    def _desired_devices(self, state) -> list:
+        """spec.peers plus live devices an introducer brought in
+        (updateSyncthingDevices syncthing.go:32-119 retains introduced
+        nodes as long as their introducer is still configured — wiping
+        them every poll would defeat the introducer feature)."""
+        my_id = state.my_id
+        desired = {p.id: {"id": p.id, "address": p.address,
+                          "introducer": p.introducer}
+                   for p in self.spec.peers if p.id != my_id}
+        introducers = {p.id for p in self.spec.peers if p.introducer}
+        for dev in state.config.get("devices", []):
+            did = dev.get("id")
+            if (did and did not in desired
+                    and dev.get("introduced_by") in introducers):
+                desired[did] = dev
+        return sorted(desired.values(), key=lambda d: d["id"])
 
     def _ensure_is_configured(self, state, secret, api_addr, api_port):
-        """Diff the live device list against spec.peers and publish when
-        they differ (ensureIsConfigured :673-720 + updateSyncthingDevices
-        syncthing.go:32-119)."""
-        desired = self._desired_devices(state.my_id)
+        """Diff the live device list against the desired set and publish
+        when they differ (ensureIsConfigured :673-720)."""
+        desired = self._desired_devices(state)
         current = sorted(state.config.get("devices", []),
                          key=lambda d: d.get("id", ""))
         if current != desired:
@@ -226,15 +235,18 @@ class SyncthingMover:
         st.id = state.my_id
         addr, port = self._service_endpoint(data_svc)
         st.address = f"tcp://{addr}:{port}" if addr else None
+        # Status covers the LIVE device list (spec peers + introduced),
+        # with introduced_by carried through (getConnectedPeers :740-782).
         st.peers = [
             SyncthingPeerStatus(
-                address=state.connections.get(p.id, {}).get("address",
-                                                            p.address),
-                id=p.id,
-                connected=state.connections.get(p.id, {}).get("connected",
-                                                              False),
+                address=state.connections.get(d["id"], {}).get(
+                    "address", d.get("address", "")),
+                id=d["id"],
+                connected=state.connections.get(d["id"], {}).get(
+                    "connected", False),
+                introduced_by=d.get("introduced_by"),
             )
-            for p in self.spec.peers if p.id != state.my_id
+            for d in self._desired_devices(state)
         ]
 
 

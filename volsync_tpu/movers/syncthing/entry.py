@@ -239,6 +239,14 @@ class SyncthingDaemon:
             # we just return ours (both sides pull what they need).
             return {"verb": "ok", "index": self.index.snapshot()}
 
+        def devices(msg):
+            # Introduction: a peer that trusts us as an introducer asks
+            # for the devices WE know (syncthing's introducer concept —
+            # common_types.go:64-75 carries the flag).
+            return {"verb": "ok", "devices": [
+                {"id": d["id"], "address": d.get("address", "")}
+                for d in self.peer_devices()]}
+
         def pull(msg):
             rel = msg.get("rel", "")
             off = int(msg.get("offset", 0))
@@ -256,7 +264,7 @@ class SyncthingDaemon:
             return {"verb": "ok", "data": piece,
                     "eof": len(piece) < _PULL_CHUNK}
 
-        return {"index": index, "pull": pull}
+        return {"index": index, "pull": pull, "devices": devices}
 
     # -- sync loop ----------------------------------------------------------
 
@@ -377,8 +385,8 @@ class SyncthingDaemon:
 
     def _sync_with(self, dev: dict):
         addr = dev.get("address", "")
-        if not addr.startswith("tcp://"):
-            return
+        if not isinstance(addr, str) or not addr.startswith("tcp://"):
+            return  # malformed/foreign address: skip, never crash the loop
         host, _, port = addr[len("tcp://"):].rpartition(":")
         try:
             ch = transport.connect_device(host, int(port), self.private,
@@ -391,12 +399,57 @@ class SyncthingDaemon:
             reply = ch.recv()
             self.connected[dev["id"]] = time.time()
             self._apply_remote(ch, reply.get("index", {}))
+            if dev.get("introducer"):
+                ch.send({"verb": "devices"})
+                self._adopt_introduced(dev["id"],
+                                       ch.recv().get("devices", []))
             ch.send({"verb": "shutdown", "rc": 0})
             ch.recv()
         except (OSError, ChannelError):
             pass
         finally:
             ch.close()
+
+    def _adopt_introduced(self, introducer_id: str, devices: list):
+        """Reconcile devices learned from an introducer into the live
+        config (syncthing's introducer semantics): unknown IDs become
+        peers stamped introduced_by; addresses of devices WE got from
+        this introducer refresh when the introducer re-advertises them
+        (daemons bind ephemeral ports — stale addresses strand peers);
+        and devices this introducer no longer advertises are REVOKED
+        (real syncthing drops them the same way)."""
+        advertised = {
+            d["id"]: d.get("address", "")
+            for d in devices
+            if isinstance(d.get("id"), str)
+            and isinstance(d.get("address", ""), str)
+            and d["id"] != self.my_id
+        }
+        with self.cfg_lock:
+            out = []
+            changed = False
+            present = set()
+            for dev in self.config.get("devices", []):
+                did = dev.get("id")
+                present.add(did)
+                if dev.get("introduced_by") == introducer_id:
+                    if did not in advertised:
+                        changed = True  # revoked by the introducer
+                        continue
+                    if dev.get("address") != advertised[did]:
+                        dev = {**dev, "address": advertised[did]}
+                        changed = True  # ephemeral port moved
+                out.append(dev)
+            for did, address in advertised.items():
+                if did not in present:
+                    out.append({"id": did, "address": address,
+                                "introducer": False,
+                                "introduced_by": introducer_id})
+                    changed = True
+            if changed:
+                self.put_config({"devices": out})
+                log.info("introducer %s reconciled: %d device(s) known",
+                         introducer_id[:12], len(out))
 
     # -- servers ------------------------------------------------------------
 
