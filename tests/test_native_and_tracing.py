@@ -139,10 +139,10 @@ def test_engine_emits_spans(rng):
     from volsync_tpu.engine.chunker import DeviceChunkHasher
 
     reset_spans()
-    params = GearParams(min_size=4096, avg_size=16384, max_size=65536)
+    params = GearParams(min_size=4096, avg_size=32768, max_size=65536,
+                        align=4096)
     buf = np.frombuffer(rng.bytes(300_000), np.uint8)
     DeviceChunkHasher(params).process(buf)
     totals = span_totals()
-    assert totals.get("engine.candidates", (0,))[0] >= 1
-    assert totals.get("engine.boundary_walk", (0,))[0] >= 1
-    assert totals.get("engine.leaf_fetch_assemble", (0,))[0] >= 1
+    assert totals.get("engine.fused_dispatch", (0,))[0] >= 1
+    assert totals.get("engine.fused_fetch", (0,))[0] >= 1

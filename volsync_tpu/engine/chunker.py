@@ -67,10 +67,32 @@ class DeviceChunkHasher:
     (padded buffer sizes, fixed candidate capacity, size-classed chunk
     batches with pow2 lane counts) so the jit cache converges after a few
     segments regardless of workload shape.
+
+    With the page-aligned format (align == 4096, the repo default) the
+    whole segment runs as ONE fused device program with ONE small result
+    fetch (ops/segment.py): candidates, the FastCDC walk, leaf hashing,
+    and Merkle-root assembly all stay on device, and only the chunk
+    table + 32-byte roots come back (~40 bytes per ~1 MiB chunk instead
+    of 32 bytes per 4 KiB leaf plus a candidate round-trip). The chunk
+    list is then known only at ``finish()`` — segments of ONE stream
+    serialize on that fetch, and scaling comes from concurrent streams
+    (many CRs per chip), matching the reference's concurrency model
+    (reference: controllers/replicationsource_controller.go:145).
+    64 <= align < 4096 keeps the split-phase pipeline (synchronous
+    boundary walk, leaf hashing left in flight); align=1 the legacy
+    shift-invariant path.
     """
 
     def __init__(self, params: GearParams):
         self.params = params
+        from volsync_tpu.ops.segment import LEAF_SIZE
+
+        if params.align == LEAF_SIZE:  # the page-aligned fused format
+            from volsync_tpu.ops.segment import FusedSegmentHasher
+
+            self.fused = FusedSegmentHasher(params)
+        else:
+            self.fused = None
 
     def process(self, buffer, *, eof: bool = True) -> list[tuple[int, int, str]]:
         """-> [(start, length, sha256-hex)] covering ``buffer`` (the tail
@@ -78,11 +100,12 @@ class DeviceChunkHasher:
         return self.begin(buffer, eof=eof).finish()
 
     def begin(self, buffer, *, eof: bool = True) -> "PendingSegment":
-        """Upload + dispatch the segment's device work; the boundary walk
-        runs synchronously (it needs only the small candidate fetch), but
-        the heavy leaf hashing is left IN FLIGHT — callers overlap the
-        next segment's host I/O/upload with it and call .finish() late
-        (the double-buffered streaming pipeline)."""
+        """Upload + dispatch the segment's device work, leaving it IN
+        FLIGHT. On the fused path the chunk table itself is part of the
+        one in-flight result, so ``.chunks``/``.end`` block until the
+        fetch; on the split-phase path (align < 4096) the boundary walk
+        runs synchronously here and only the leaf digests stay in
+        flight."""
         import jax.numpy as jnp
 
         if isinstance(buffer, (bytes, bytearray, memoryview)):
@@ -107,6 +130,11 @@ class DeviceChunkHasher:
         from volsync_tpu.obs import span
 
         p = self.params
+        if self.fused is not None:
+            with span("engine.fused_dispatch"):
+                inflight = self.fused.dispatch(dev, length, eof=eof)
+            return PendingSegment.fused_segment(
+                self.fused, dev, length, inflight, eof)
         with span("engine.candidates"):
             idx_s, idx_l = self._candidates(dev, length)
         with span("engine.boundary_walk"):
@@ -114,12 +142,13 @@ class DeviceChunkHasher:
         if not chunks:
             return PendingSegment([], None, None)
         if p.align >= 64:
+            # Split-phase aligned path (64 <= align < 4096): leaf digests
+            # stay in flight; chunks are known synchronously.
             plan = _leaf_plan(chunks)
-            full_rows, short_starts, short_lengths = plan[0], plan[1], plan[2]
             dev_digests = _dispatch_leaves(
-                dev, full_rows, short_starts, short_lengths,
+                dev, plan[0], plan[1], plan[2],
                 leaf_fn=self.leaf_device_fn)
-            return PendingSegment(None, chunks, (plan, dev_digests))
+            return PendingSegment.split_phase(chunks, (plan, dev_digests))
         # Legacy unaligned path: synchronous gather hashing.
         hexes = device_span_roots(dev, chunks, aligned=False)
         return PendingSegment(
@@ -130,7 +159,8 @@ class DeviceChunkHasher:
                        eof: bool = True) -> list[tuple[int, int, str]]:
         """The device pipeline on an already-resident padded buffer —
         what process() runs after upload, and what bench.py measures:
-        candidates -> host boundary walk -> leaf digests -> roots."""
+        one fused dispatch (candidates -> on-device walk -> leaf digests
+        -> roots) plus its single result fetch."""
         return self.begin_device(dev, length, eof=eof).finish()
 
     def _candidates(self, dev, length: int):
@@ -259,20 +289,49 @@ def _assemble_roots(chunks, plan, digests_np, lanes_f) -> list[str]:
 
 
 class PendingSegment:
-    """A segment whose boundary walk is done but whose leaf digests may
-    still be computing on device. ``chunks`` is available immediately
-    (the streaming pipeline needs it to advance its buffer); finish()
-    performs the one digest fetch and assembles blob ids."""
+    """A segment whose device work may still be in flight.
+
+    Legacy (align < 64) segments know their chunk list immediately; the
+    fused path (ops/segment.py) learns it from the one result fetch, so
+    ``chunks`` / ``end`` force ``finish()`` there. Either way the
+    public protocol is: ``.end`` = bytes consumed, ``finish()`` ->
+    [(start, length, blob-id-hex)]."""
 
     def __init__(self, done, chunks, inflight):
         self._done = done
         self._inflight = inflight
-        self.chunks = (chunks if chunks is not None
-                       else [(s, l) for s, l, _ in (done or [])])
+        self._fused = None
+        self._chunks = (chunks if chunks is not None
+                        else [(s, l) for s, l, _ in (done or [])])
+
+    @classmethod
+    def fused_segment(cls, fsh, dev, length, inflight, eof):
+        seg = cls([], None, None)
+        seg._done = None
+        seg._chunks = None
+        seg._fused = (fsh, dev, length, inflight, eof)
+        return seg
+
+    @classmethod
+    def split_phase(cls, chunks, inflight):
+        seg = cls([], None, None)
+        seg._done = None
+        seg._chunks = list(chunks)
+        seg._inflight = inflight
+        return seg
+
+    @property
+    def chunks(self) -> list[tuple[int, int]]:
+        if self._chunks is None:
+            self.finish()
+        return self._chunks
 
     @property
     def end(self) -> int:
         """One past the last covered byte (0 if nothing was emitted)."""
+        if self._fused is not None and self._done is None:
+            self.finish()
+            return self._consumed
         if not self.chunks:
             return 0
         s, l = self.chunks[-1][0], self.chunks[-1][1]
@@ -283,12 +342,20 @@ class PendingSegment:
             return self._done
         from volsync_tpu.obs import span
 
+        if self._fused is not None:
+            fsh, dev, length, inflight, eof = self._fused
+            with span("engine.fused_fetch"):
+                chunks, consumed = fsh.finish(dev, length, inflight, eof=eof)
+            self._done = chunks
+            self._chunks = [(s, l) for s, l, _ in chunks]
+            self._consumed = consumed
+            return self._done
         (plan, (dev_digests, lanes_f)) = self._inflight
         with span("engine.leaf_fetch_assemble"):
-            hexes = _assemble_roots(self.chunks, plan,
+            hexes = _assemble_roots(self._chunks, plan,
                                     np.asarray(dev_digests), lanes_f)
         self._done = [(int(s), int(l), h)
-                      for (s, l), h in zip(self.chunks, hexes)]
+                      for (s, l), h in zip(self._chunks, hexes)]
         self._inflight = None
         return self._done
 
@@ -387,11 +454,14 @@ def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
     on device; the unterminated tail of each segment is carried into the
     next so boundaries match one-shot chunking.
 
-    Double-buffered: each segment's boundary walk is synchronous (it
-    gates how far the buffer advances) but its leaf hashing stays in
-    flight while the NEXT segment is read from disk and uploaded — the
-    host I/O and the device SHA-256 overlap, and result round-trips of
-    consecutive segments pipeline.
+    On the fused path (align >= 64) each segment is one device dispatch
+    and one small result fetch; the buffer can only advance once that
+    fetch lands, so segments of one stream serialize on a single
+    round-trip each (sub-ms on a TPU VM). Aggregate throughput scales
+    across concurrent streams — one per ReplicationSource, mirroring the
+    reference's MaxConcurrentReconciles=100 concurrency model — and with
+    the segment size. The legacy (align < 64) path keeps the old
+    split-phase overlap.
     """
     hasher = hasher or DeviceChunkHasher(params)
     pending = b""

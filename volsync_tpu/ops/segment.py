@@ -1,0 +1,433 @@
+"""Fused single-dispatch segment pipeline: chunk + hash + Merkle roots.
+
+The per-segment protocol of the original engine (engine/chunker.py) was
+two device dispatches with two result fetches: (1) compacted CDC
+candidates -> host FastCDC walk, (2) leaf digests -> host root assembly.
+Every result fetch costs a fixed round trip (~70 ms through a serving
+tunnel; ~100 us on a local TPU VM), and the digest fetch moves 32 bytes
+per 4 KiB leaf — ~8 MiB per GiB of input. This module collapses the
+whole segment into ONE device program with ONE small result fetch
+(~20 KiB: the chunk table + one 32-byte blob id per chunk).
+
+The enabling format choice is ``GearParams.align == 4096``: cut
+positions land on the 4 KiB Merkle-leaf grid, so every full leaf of
+every chunk IS a page of the segment — leaf hashing becomes *contiguous*
+page hashing with no gather at all, and at most ONE leaf per segment
+(the final eof tail) is partial. That matters because on TPU the only
+fast bulk primitives are elementwise/reduction ops and Pallas kernels:
+XLA-level gathers and transposes of data-sized arrays run at ~1% of HBM
+bandwidth on the serving-tunnel AOT path (measured), so the pipeline is
+built exclusively from:
+
+- elementwise candidate masks + small ``nonzero`` compactions;
+- a ``lax.while_loop`` FastCDC walk over compacted candidates,
+  bit-identical to ``gearcdc._select_boundaries_py`` (golden-tested);
+- a Pallas tile-transpose (VMEM shuffles, ~HBM speed) feeding the
+  Pallas SHA-256 lane kernel, digests kept in kernel layout;
+- a root stage that hashes "VMRK1" || le64(len) || leaf-digests
+  (repo/blobid.py) with a while_loop over message blocks — a 17-word
+  gather per block per chunk lane, nothing data-sized.
+
+Replaces the hot loop of the reference's vendored restic engine
+(reference: mover-restic/entry.sh:63, Dockerfile:7-10) on its real
+streaming path; engine/chunker.DeviceChunkHasher dispatches this program
+when the page-aligned format is active.
+
+Capacity model: all shapes are static under jit. ``segment_caps`` sizes
+the candidate/chunk tables from the segment length with generous
+headroom; the packed result carries the TRUE counts and the host
+retries with doubled capacities iff real data overflowed (adversarial
+inputs only). eof is a static arg (two compiled variants per shape).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from volsync_tpu.ops.gearcdc import GearParams, gear_at_aligned
+from volsync_tpu.ops.sha256 import (
+    _H0,
+    _LANE_SUB,
+    _LANE_TILE,
+    _compress,
+    _sha256_leaf_kernel,
+    _sha256_rows,
+    pack_words,
+    sha256_chunks_device,
+    use_pallas_leaves,
+)
+
+LEAF_SIZE = 4096  # == repo.blobid.LEAF_SIZE (static repo format constant)
+_DOMAIN_WORD0 = int.from_bytes(b"VMRK", "big")  # "VMRK1" header, word 0
+_DOMAIN_BYTE4 = b"VMRK1"[4]
+
+
+from volsync_tpu.ops.gearcdc import _pow2ceil_int as _pow2ceil
+
+
+def segment_caps(padded_len: int, params: GearParams) -> tuple[int, int]:
+    """(cand_cap, chunk_cap) for a padded segment length.
+
+    Expected lax-candidate density is 2^-(eff_bits-norm) per aligned
+    position — the default gives ~8-16x headroom. chunk_cap covers the
+    min_size packing bound exactly (+ slack for the eof tail)."""
+    chunk_cap = _pow2ceil(padded_len // params.min_size + 2, 16)
+    cand_cap = max(4096, _pow2ceil(4 * padded_len // params.avg_size, 4096))
+    return cand_cap, chunk_cap
+
+
+def _select_boundaries_device(pos_s, ns, pos_l, nl, valid_len, *,
+                              min_size: int, avg_size: int, max_size: int,
+                              chunk_cap: int, eof: bool):
+    """lax.while_loop FastCDC walk == gearcdc._select_boundaries_py.
+
+    pos_s/pos_l: sorted compacted candidate cut positions (padded with a
+    sentinel greater than any valid position); ns/nl their true counts.
+    Returns (starts[chunk_cap], lens[chunk_cap], count, consumed).
+    """
+    i32 = jnp.int32
+    L = valid_len.astype(i32)
+
+    def cond(c):
+        pos, cnt, done, _, _ = c
+        return (~done) & (pos < L) & (cnt < chunk_cap)
+
+    def body(c):
+        pos, cnt, done, starts, lens = c
+        lo = pos + (min_size - 1)
+        mid = pos + (avg_size - 1)
+        hi = pos + (max_size - 1)
+        # First strict candidate in [lo, min(mid-1, L-1, hi)].
+        i = jnp.searchsorted(pos_s, lo, side="left").astype(i32)
+        cs = pos_s[jnp.clip(i, 0, pos_s.shape[0] - 1)]
+        lim_s = jnp.minimum(jnp.minimum(mid - 1, L - 1), hi)
+        found_s = (i < ns) & (cs <= lim_s)
+        # Else first lax candidate in [max(lo, mid), min(hi, L-1)].
+        j = jnp.searchsorted(pos_l, jnp.maximum(lo, mid),
+                             side="left").astype(i32)
+        cl = pos_l[jnp.clip(j, 0, pos_l.shape[0] - 1)]
+        found_l = (j < nl) & (cl <= jnp.minimum(hi, L - 1))
+        hi_ok = hi <= L - 1
+        cut = jnp.where(found_s, cs,
+                        jnp.where(found_l, cl,
+                                  jnp.where(hi_ok, hi, L - 1)))
+        emit = found_s | found_l | hi_ok | jnp.bool_(eof)
+        # Predicated append: drop the write when not emitting.
+        wr = jnp.where(emit, cnt, chunk_cap)
+        starts = starts.at[wr].set(pos, mode="drop")
+        lens = lens.at[wr].set(cut - pos + 1, mode="drop")
+        return (jnp.where(emit, cut + 1, pos), cnt + emit.astype(i32),
+                ~emit, starts, lens)
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.bool_(False),
+            jnp.zeros((chunk_cap,), i32), jnp.zeros((chunk_cap,), i32))
+    pos, cnt, _, starts, lens = jax.lax.while_loop(cond, body, init)
+    return starts, lens, cnt, pos
+
+
+# ---------------------------------------------------------------------------
+# Page-digest stage: contiguous leaf hashing, no gathers
+# ---------------------------------------------------------------------------
+
+def _transpose_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...].T
+
+
+def _pallas_transpose(x: jax.Array) -> jax.Array:
+    """[R, C] u32 -> [C, R] via VMEM tile shuffles. XLA's own transpose
+    lowering runs at ~0.1 GiB/s on the tunnel AOT path; this runs at
+    ~HBM speed. R % 256 == 0, C % 256 == 0."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C = x.shape
+    return pl.pallas_call(
+        _transpose_kernel,
+        grid=(R // 256, C // 256),
+        in_specs=[pl.BlockSpec((256, 256), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((256, 256), lambda i, j: (j, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C, R), jnp.uint32),
+    )(x)
+
+
+def _page_digests_flat(data: jax.Array, n_pages_pad: int) -> jax.Array:
+    """SHA-256 of every 4 KiB page of ``data``, WORD-MAJOR flat layout:
+    result[j * n_pages_pad + p] = word j of page p's digest.
+
+    data: [P] uint8, P % LEAF_SIZE == 0; hashes are computed for
+    ``n_pages_pad`` >= P/LEAF_SIZE pages (the pad region hashes zeros
+    and is never referenced by the root stage).
+
+    TPU: pack_words (elementwise) -> Pallas tile-transpose -> the
+    Pallas SHA lane kernel; the digest output stays in the kernel's
+    [8, B/128, 128] layout, whose row-major flattening IS word-major.
+    CPU (tests/dry-runs): the XLA scan path + a small transpose.
+    """
+    P = data.shape[0]
+    F = P // LEAF_SIZE
+
+    if not use_pallas_leaves():
+        wb = pack_words(data)  # [P/64, 16]
+        rows0 = jnp.arange(n_pages_pad, dtype=jnp.int32) * (LEAF_SIZE // 64)
+        rows0 = jnp.minimum(rows0, P // 64 - LEAF_SIZE // 64)
+        dig = _sha256_rows(wb, rows0, LEAF_SIZE)  # [n_pages_pad, 8]
+        return dig.T.reshape(-1)
+
+    # Words packed straight into [F, 1024]: any [*, 16]-minor layout
+    # tile-pads 8x on TPU, and 1-D stride-4 slices lower ~100x slower
+    # than the same stride on a 2-D minor dim (measured) — so: page
+    # rows first, then minor-dim byte strides.
+    r = data.reshape(F, LEAF_SIZE)
+    b0 = r[:, 0::4].astype(jnp.uint32)
+    b1 = r[:, 1::4].astype(jnp.uint32)
+    b2 = r[:, 2::4].astype(jnp.uint32)
+    b3 = r[:, 3::4].astype(jnp.uint32)
+    x2 = ((b0 << np.uint32(24)) | (b1 << np.uint32(16))
+          | (b2 << np.uint32(8)) | b3)  # [F, 1024]
+    if n_pages_pad != F:
+        x2 = jnp.pad(x2, ((0, n_pages_pad - F), (0, 0)))
+    xt = _pallas_transpose(x2)  # [1024, n_pages_pad]
+    x = xt.reshape(64, 16, n_pages_pad // 128, 128)
+
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    out = pl.pallas_call(
+        _sha256_leaf_kernel,
+        grid=(n_pages_pad // _LANE_TILE, 64),
+        in_specs=[pl.BlockSpec((1, 16, _LANE_SUB, 128),
+                               lambda i, t: (t, 0, i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, _LANE_SUB, 128), lambda i, t: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, n_pages_pad // 128, 128),
+                                       jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((8, _LANE_SUB, 128), jnp.uint32)],
+    )(x)
+    return out.reshape(-1)  # [8 * n_pages_pad], word-major
+
+
+# ---------------------------------------------------------------------------
+# Root stage: while_loop over message blocks, small per-block gathers
+# ---------------------------------------------------------------------------
+
+def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live):
+    """Blob ids (repo/blobid.py: SHA-256 of "VMRK1" || le64(len) ||
+    leaf digests) from word-major page digests.
+
+    flat: [8 * n_pages_pad] u32 — word j of page p at j*n_pages_pad + p
+    (tail-leaf override already applied). page0: [C_cap] first page of
+    each chunk; nleaves/lens/live: the chunk table.
+
+    The digest stream of chunk c is D(t) = flat[(t%8)*n_pages_pad +
+    page0[c] + t//8]. The 13-byte header shifts it to byte offset
+    13 = 4*3+1, so message word q >= 4 is the byte-splice
+    (D(q-4) << 24) | (D(q-3) >> 8); words 0..3 are header constants and
+    the FIPS terminator/bit-length overlay at computed word indices.
+    A while_loop runs only to the LARGEST live chunk's block count —
+    per iteration one [C_cap, 17]-word gather + one compression, so
+    low-entropy segments (few, max_size chunks) don't pay a
+    max-possible-length scan.
+    """
+    C_cap = page0.shape[0]
+    nl8 = 8 * nleaves  # digest stream length in words
+    nb = (32 * nleaves + 13 + 9 + 63) // 64  # true block counts [C_cap]
+    max_nb = jnp.max(jnp.where(live, nb, 0))
+    qterm = 3 + nl8  # word holding the 0x80 terminator (byte 1)
+    qlen = nb * 16 - 1  # word holding the bit length
+    bitlen = (13 + 32 * nleaves.astype(jnp.uint32)) * jnp.uint32(8)
+
+    lens_u = lens.astype(jnp.uint32)
+    w1 = ((jnp.uint32(_DOMAIN_BYTE4) << jnp.uint32(24))
+          | ((lens_u & jnp.uint32(0xFF)) << jnp.uint32(16))
+          | (((lens_u >> jnp.uint32(8)) & jnp.uint32(0xFF)) << jnp.uint32(8))
+          | ((lens_u >> jnp.uint32(16)) & jnp.uint32(0xFF)))
+    w2 = ((lens_u >> jnp.uint32(24)) & jnp.uint32(0xFF)) << jnp.uint32(24)
+
+    Fp = n_pages_pad
+    jj = jnp.arange(17, dtype=jnp.int32)[None, :]  # D indices n*16-4+j
+
+    def cond(c):
+        return c[0] < max_nb
+
+    def body(c):
+        n, state = c
+        t = n * 16 - 4 + jj  # [1,17] broadcast over lanes
+        tc = jnp.clip(t, 0, Fp * 8 - 1)
+        idx = (tc % 8) * Fp + page0[:, None] + tc // 8
+        d = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]  # [C_cap, 17]
+        d = jnp.where((t >= 0) & (t < nl8[:, None]), d, jnp.uint32(0))
+        blk = (d[:, :16] << jnp.uint32(24)) | (d[:, 1:] >> jnp.uint32(8))
+        q = n * 16 + jnp.arange(16, dtype=jnp.int32)[None, :]  # [1,16]
+        blk = jnp.where(q == 0, jnp.uint32(_DOMAIN_WORD0), blk)
+        blk = jnp.where(q == 1, w1[:, None], blk)
+        blk = jnp.where(q == 2, w2[:, None], blk)
+        blk = jnp.where(q == 3, d[:, 4:5] >> jnp.uint32(8), blk)
+        blk = jnp.where(q == qterm[:, None],
+                        blk | jnp.uint32(0x00800000), blk)
+        blk = jnp.where(q == qlen[:, None], bitlen[:, None], blk)
+        new = _compress(state, blk)
+        keep = (n < nb)[:, None]
+        return n + 1, jnp.where(keep, new, state)
+
+    state0 = jnp.broadcast_to(jnp.asarray(_H0), (C_cap, 8))
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state0))
+    return state
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("min_size", "avg_size", "max_size", "seed", "mask_s",
+                     "mask_l", "align", "eof", "cand_cap", "chunk_cap"))
+def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
+                       avg_size: int, max_size: int, seed: int, mask_s: int,
+                       mask_l: int, align: int, eof: bool, cand_cap: int,
+                       chunk_cap: int) -> jax.Array:
+    """The whole segment in one device program, one small result.
+
+    data: [P] uint8, P % LEAF_SIZE == 0 (zero-padded; candidates beyond
+    ``valid_len`` are masked); requires align == LEAF_SIZE (the
+    page-aligned cut format). Returns ONE uint32 array
+    ``[4 + chunk_cap*10]``: header (count, consumed, true lax-candidate
+    count, page count) then starts[chunk_cap], lens[chunk_cap],
+    roots[chunk_cap*8]. Decode with ``decode_segment``.
+    """
+    assert align == LEAF_SIZE, "fused path requires page-aligned cuts"
+    P = data.shape[0]
+    R = P // align
+    F = P // LEAF_SIZE
+    n_pages_pad = max(_LANE_TILE, (F + _LANE_TILE - 1)
+                      // _LANE_TILE * _LANE_TILE) \
+        if use_pallas_leaves() else F
+    valid_len = jnp.asarray(valid_len, jnp.int32)
+
+    # --- candidates (aligned gear evaluation, as cdc_candidates_aligned)
+    h = gear_at_aligned(data, seed, align)
+    pos_all = (jnp.arange(R, dtype=jnp.int32) * align + (align - 1))
+    ok = pos_all < valid_len
+    is_s = ((h & np.uint32(mask_s)) == 0) & ok
+    is_l = ((h & np.uint32(mask_l)) == 0) & ok
+    sentinel = jnp.int32(2**31 - 2)  # > any valid cut position
+    ridx_s = jnp.nonzero(is_s, size=cand_cap, fill_value=R)[0]
+    ridx_l = jnp.nonzero(is_l, size=cand_cap, fill_value=R)[0]
+    pos_s = jnp.where(ridx_s < R,
+                      ridx_s.astype(jnp.int32) * align + (align - 1),
+                      sentinel)
+    pos_l = jnp.where(ridx_l < R,
+                      ridx_l.astype(jnp.int32) * align + (align - 1),
+                      sentinel)
+    ns = jnp.sum(is_s).astype(jnp.int32)
+    nl = jnp.sum(is_l).astype(jnp.int32)
+
+    # --- FastCDC boundary walk (on device)
+    starts, lens, count, consumed = _select_boundaries_device(
+        pos_s, jnp.minimum(ns, cand_cap), pos_l, jnp.minimum(nl, cand_cap),
+        valid_len, min_size=min_size, avg_size=avg_size, max_size=max_size,
+        chunk_cap=chunk_cap, eof=eof)
+
+    # --- page digests (all full leaves are pages; no gather)
+    flat = _page_digests_flat(data, n_pages_pad)
+
+    # --- the ONE possibly-partial leaf: the final chunk's tail page.
+    # Interior cuts land on the page grid (align == LEAF_SIZE and
+    # min/avg/max are page multiples), so only the last chunk (eof, or
+    # a chunk_cap-overflow remainder) can end off-grid.
+    live = jnp.arange(chunk_cap, dtype=jnp.int32) < count
+    end = jnp.where(count > 0,
+                    starts[jnp.maximum(count - 1, 0)]
+                    + lens[jnp.maximum(count - 1, 0)], 0)
+    has_tail = (count > 0) & (end % LEAF_SIZE != 0)
+    tail_page = jnp.maximum(end - 1, 0) // LEAF_SIZE
+    tail_len = end - tail_page * LEAF_SIZE
+    tail_dig = sha256_chunks_device(
+        data, (tail_page * LEAF_SIZE)[None],
+        jnp.where(has_tail, tail_len, 0)[None], max_len=LEAF_SIZE)[0]
+    ovr = jnp.where(has_tail,
+                    jnp.arange(8, dtype=jnp.int32) * n_pages_pad + tail_page,
+                    8 * n_pages_pad)  # OOB -> dropped
+    flat = flat.at[ovr].set(tail_dig, mode="drop")
+
+    # --- roots
+    nleaves = jnp.where(live, (lens + (LEAF_SIZE - 1)) // LEAF_SIZE, 0)
+    page0 = starts // LEAF_SIZE
+    roots = _root_digests_loop(flat, n_pages_pad, page0, nleaves, lens, live)
+
+    header = jnp.stack([count.astype(jnp.uint32),
+                        consumed.astype(jnp.uint32),
+                        nl.astype(jnp.uint32),
+                        jnp.sum(nleaves).astype(jnp.uint32)])
+    return jnp.concatenate([
+        header, starts.astype(jnp.uint32), lens.astype(jnp.uint32),
+        roots.reshape(-1)])
+
+
+def decode_segment(packed: np.ndarray, chunk_cap: int
+                   ) -> tuple[list[tuple[int, int, str]], int, int, int]:
+    """packed u32 array -> ([(start, len, root-hex)], consumed,
+    true_candidates, total_leaves)."""
+    packed = np.asarray(packed, dtype=np.uint32)
+    count = int(packed[0])
+    consumed = int(packed[1])
+    n_cand = int(packed[2])
+    n_leaves = int(packed[3])
+    starts = packed[4: 4 + chunk_cap].astype(np.int64)
+    lens = packed[4 + chunk_cap: 4 + 2 * chunk_cap].astype(np.int64)
+    roots = packed[4 + 2 * chunk_cap:].reshape(chunk_cap, 8).astype(">u4")
+    out = [(int(starts[c]), int(lens[c]), roots[c].tobytes().hex())
+           for c in range(count)]
+    return out, consumed, n_cand, n_leaves
+
+
+class FusedSegmentHasher:
+    """Host driver for ``chunk_hash_segment``: capacity bucketing +
+    overflow retry. Stateless apart from the params; safe to share
+    across threads (jit cache is global)."""
+
+    def __init__(self, params: GearParams):
+        assert params.align == LEAF_SIZE, \
+            "fused path requires the page-aligned cut format (align=4096)"
+        self.params = params
+
+    #: Override point (benchmarks compose a content salt into the same
+    #: program); None = chunk_hash_segment on the library kernels.
+    segment_device_fn = None
+
+    def dispatch(self, dev, length: int, *, eof: bool,
+                 cand_cap: int | None = None, chunk_cap: int | None = None):
+        p = self.params
+        P = int(dev.shape[0])
+        cc, kc = segment_caps(P, p)
+        cand_cap = cand_cap or cc
+        chunk_cap = chunk_cap or kc
+        fn = self.segment_device_fn or chunk_hash_segment
+        return fn(dev, length, min_size=p.min_size, avg_size=p.avg_size,
+                  max_size=p.max_size, seed=p.seed, mask_s=p.mask_s,
+                  mask_l=p.mask_l, align=p.align, eof=eof,
+                  cand_cap=cand_cap, chunk_cap=chunk_cap), \
+            (cand_cap, chunk_cap)
+
+    def finish(self, dev, length: int, inflight, *, eof: bool):
+        """Fetch + decode; re-dispatch with doubled capacities iff the
+        true counts overflowed the compiled tables (adversarial data)."""
+        handle, (cand_cap, chunk_cap) = inflight
+        while True:
+            chunks, consumed, n_cand, _ = decode_segment(
+                np.asarray(handle), chunk_cap)
+            retry = False
+            if n_cand > cand_cap:
+                cand_cap = _pow2ceil(n_cand, cand_cap * 2)
+                retry = True
+            if len(chunks) >= chunk_cap and (consumed < length):
+                chunk_cap = chunk_cap * 2
+                retry = True
+            if not retry:
+                return chunks, consumed
+            handle, (cand_cap, chunk_cap) = self.dispatch(
+                dev, length, eof=eof, cand_cap=cand_cap,
+                chunk_cap=chunk_cap)

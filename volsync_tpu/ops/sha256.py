@@ -230,17 +230,20 @@ def pack_words(data: jax.Array) -> jax.Array:
     it into their own jit so the 1x-data-sized word array never
     materializes across a dispatch boundary.
 
-    Uses a bitcast + byteswap instead of a [L/4, 4]->u32 combine: a
-    uint32[N, 4] intermediate tiles to (8, 128) on TPU, a 32x padding
-    blowup that OOMs at large L."""
+    Stride-4 byte lanes on a 2-D minor dim combine into big-endian
+    words. Any variant routing through an [..., 4]-minor array
+    (reshape+combine OR the bitcast trick, whose *input* is u8[L/4, 4])
+    tile-pads the minor dim to 128 on TPU — a 32x HBM blowup that OOMs
+    at 256 MiB segments — and 1-D stride-4 slices lower ~100x slower
+    than the same stride on a 2-D minor dim (measured on v5e)."""
     L = data.shape[0]
-    w_le = jax.lax.bitcast_convert_type(
-        data.reshape(L // 4, 4), jnp.uint32)  # [L/4] little-endian
-    w = ((w_le & np.uint32(0xFF)) << np.uint32(24)) \
-        | ((w_le & np.uint32(0xFF00)) << np.uint32(8)) \
-        | ((w_le >> np.uint32(8)) & np.uint32(0xFF00)) \
-        | (w_le >> np.uint32(24))
-    return w.reshape(L // 64, 16)
+    r = data.reshape(L // 64, 64)
+    b0 = r[:, 0::4].astype(jnp.uint32)
+    b1 = r[:, 1::4].astype(jnp.uint32)
+    b2 = r[:, 2::4].astype(jnp.uint32)
+    b3 = r[:, 3::4].astype(jnp.uint32)
+    return ((b0 << np.uint32(24)) | (b1 << np.uint32(16))
+            | (b2 << np.uint32(8)) | b3)
 
 
 @functools.partial(jax.jit, static_argnames=("leaf_len",))

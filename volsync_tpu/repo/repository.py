@@ -109,16 +109,18 @@ class Repository:
         config = {
             "version": 1,
             "id": hashlib.sha256(os.urandom(32)).hexdigest(),
-            # align=64: TPU-native cut alignment (ops/gearcdc.GearParams
-            # docstring) — new repos chunk on 64B-aligned boundaries so
-            # hashing runs the strided fast path. Repos created without
-            # the key keep align=1 (classic shift-invariant CDC) so their
-            # historical chunk boundaries and dedup remain valid.
+            # align=4096: page-aligned cuts (ops/gearcdc.DEFAULT_PARAMS
+            # rationale) — new repos chunk on the 4 KiB Merkle-leaf grid
+            # so the fused single-dispatch engine (ops/segment.py)
+            # hashes leaves as contiguous pages. Repos created without
+            # the key keep align=1 (classic shift-invariant CDC), and
+            # align=64 repos keep the split-phase engine, so historical
+            # chunk boundaries and dedup remain valid either way.
             "chunker": chunker or {"min_size": 512 * 1024,
                                    "avg_size": 1024 * 1024,
                                    "max_size": 8 * 1024 * 1024,
                                    "seed": 0x5EED_CDC1,
-                                   "align": 64},
+                                   "align": 4096},
             "salt": salt.hex() if salt else None,
             "verifier": box.seal(_VERIFIER_PLAINTEXT).hex() if password else None,
         }
