@@ -186,3 +186,69 @@ def test_restic_mover_e2e_mesh_engine(tmp_path, rng):
     finally:
         manager.stop()
         runner.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fused page-aligned mesh path (align == LEAF): one dispatch, one fetch,
+# replicated walk+roots over all-gathered page digests.
+# ---------------------------------------------------------------------------
+
+FUSED = GearParams(min_size=4096, avg_size=32768, max_size=65536, align=4096)
+
+
+@pytest.fixture(scope="module")
+def fused_mesh_hasher():
+    return MeshChunkHasher(FUSED)
+
+
+def test_fused_mesh_identical_to_single_chip(fused_mesh_hasher, rng):
+    buf = rng.randint(0, 256, size=(2 * 1024 * 1024 + 777,), dtype=np.uint8)
+    single = DeviceChunkHasher(FUSED).process(buf)
+    sharded = fused_mesh_hasher.process(buf)
+    assert sharded == single
+    pos = 0
+    for start, length, _ in sharded:
+        assert start == pos
+        pos += length
+    assert pos == buf.shape[0]
+    for s, l, d in sharded[:3]:
+        assert d == blobid.blob_id(buf.tobytes()[s: s + l])
+
+
+def test_fused_mesh_without_eof(fused_mesh_hasher, rng):
+    buf = rng.randint(0, 256, size=(1_500_000,), dtype=np.uint8)
+    single = DeviceChunkHasher(FUSED).process(buf, eof=False)
+    sharded = fused_mesh_hasher.process(buf, eof=False)
+    assert sharded == single
+    end = sum(l for _, l, _ in sharded)
+    assert 0 < end < buf.shape[0] and end % 4096 == 0
+
+
+def test_fused_mesh_zero_entropy_max_cuts(fused_mesh_hasher):
+    buf = np.zeros((1_000_000,), np.uint8)
+    sharded = fused_mesh_hasher.process(buf)
+    assert sharded == DeviceChunkHasher(FUSED).process(buf)
+    assert all(l <= FUSED.max_size for _, l, _ in sharded)
+    # constant data -> every chunk identical -> total dedup
+    assert len({d for _, _, d in sharded[:-1]}) == 1
+
+
+def test_fused_mesh_capacity_retry(rng):
+    # chunk_cap starts far too small for the chunk count this data
+    # produces; the in-band counts must drive the doubling retry.
+    h = MeshChunkHasher(FUSED)
+    buf = rng.randint(0, 256, size=(2 * 1024 * 1024,), dtype=np.uint8)
+    out_normal = h.process(buf)
+    h2 = MeshChunkHasher(FUSED)
+    import volsync_tpu.ops.segment as seg
+    real_caps = seg.segment_caps
+
+    def tiny_caps(padded, params):
+        return 1024 * 8, 16  # chunk_cap=16 << ~64 chunks
+
+    seg.segment_caps = tiny_caps
+    try:
+        out_tiny = h2.process(buf)
+    finally:
+        seg.segment_caps = real_caps
+    assert out_tiny == out_normal

@@ -291,10 +291,11 @@ def _assemble_roots(chunks, plan, digests_np, lanes_f) -> list[str]:
 class PendingSegment:
     """A segment whose device work may still be in flight.
 
-    Legacy (align < 64) segments know their chunk list immediately; the
-    fused path (ops/segment.py) learns it from the one result fetch, so
-    ``chunks`` / ``end`` force ``finish()`` there. Either way the
-    public protocol is: ``.end`` = bytes consumed, ``finish()`` ->
+    Split-phase (64 <= align < 4096) and legacy (align=1) segments know
+    their chunk list immediately; the fused path (align == 4096,
+    ops/segment.py) learns it from the one result fetch, so ``chunks``
+    / ``end`` force ``finish()`` there. Either way the public protocol
+    is: ``.end`` = bytes consumed, ``finish()`` ->
     [(start, length, blob-id-hex)]."""
 
     def __init__(self, done, chunks, inflight):
@@ -454,14 +455,16 @@ def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
     on device; the unterminated tail of each segment is carried into the
     next so boundaries match one-shot chunking.
 
-    On the fused path (align >= 64) each segment is one device dispatch
-    and one small result fetch; the buffer can only advance once that
-    fetch lands, so segments of one stream serialize on a single
-    round-trip each (sub-ms on a TPU VM). Aggregate throughput scales
-    across concurrent streams — one per ReplicationSource, mirroring the
-    reference's MaxConcurrentReconciles=100 concurrency model — and with
-    the segment size. The legacy (align < 64) path keeps the old
-    split-phase overlap.
+    On the fused path (align == 4096, the repo default) each segment is
+    one device dispatch and one small result fetch; the buffer can only
+    advance once that fetch lands, so segments of one stream serialize
+    on a single round-trip each (sub-ms on a TPU VM). Aggregate
+    throughput scales across concurrent streams — one per
+    ReplicationSource, mirroring the reference's
+    MaxConcurrentReconciles=100 concurrency model — and with the
+    segment size. 64 <= align < 4096 keeps the split-phase pipeline
+    (synchronous boundary walk, leaf digests in flight across loop
+    iterations); align=1 the legacy synchronous path.
     """
     hasher = hasher or DeviceChunkHasher(params)
     pending = b""

@@ -217,16 +217,20 @@ def _page_digests_flat(data: jax.Array, n_pages_pad: int) -> jax.Array:
 # Root stage: while_loop over message blocks, small per-block gathers
 # ---------------------------------------------------------------------------
 
-def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live):
+def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live,
+                       word_index=None):
     """Blob ids (repo/blobid.py: SHA-256 of "VMRK1" || le64(len) ||
     leaf digests) from word-major page digests.
 
-    flat: [8 * n_pages_pad] u32 — word j of page p at j*n_pages_pad + p
-    (tail-leaf override already applied). page0: [C_cap] first page of
-    each chunk; nleaves/lens/live: the chunk table.
+    flat: flattened u32 page digests; by default word j of page p lives
+    at j*n_pages_pad + p (the single-chip kernel layout, tail-leaf
+    override already applied). ``word_index(j, p)`` overrides that
+    mapping — the mesh-sharded path passes the all-gathered per-shard
+    layout's index function. page0: [C_cap] first page of each chunk;
+    nleaves/lens/live: the chunk table.
 
-    The digest stream of chunk c is D(t) = flat[(t%8)*n_pages_pad +
-    page0[c] + t//8]. The 13-byte header shifts it to byte offset
+    The digest stream of chunk c is D(t) = flat[word_index(t%8,
+    page0[c] + t//8)]. The 13-byte header shifts it to byte offset
     13 = 4*3+1, so message word q >= 4 is the byte-splice
     (D(q-4) << 24) | (D(q-3) >> 8); words 0..3 are header constants and
     the FIPS terminator/bit-length overlay at computed word indices.
@@ -251,6 +255,9 @@ def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live):
     w2 = ((lens_u >> jnp.uint32(24)) & jnp.uint32(0xFF)) << jnp.uint32(24)
 
     Fp = n_pages_pad
+    if word_index is None:
+        def word_index(j, p):
+            return j * Fp + p
     jj = jnp.arange(17, dtype=jnp.int32)[None, :]  # D indices n*16-4+j
 
     def cond(c):
@@ -260,7 +267,7 @@ def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live):
         n, state = c
         t = n * 16 - 4 + jj  # [1,17] broadcast over lanes
         tc = jnp.clip(t, 0, Fp * 8 - 1)
-        idx = (tc % 8) * Fp + page0[:, None] + tc // 8
+        idx = word_index(tc % 8, page0[:, None] + tc // 8)
         d = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]  # [C_cap, 17]
         d = jnp.where((t >= 0) & (t < nl8[:, None]), d, jnp.uint32(0))
         blk = (d[:, :16] << jnp.uint32(24)) | (d[:, 1:] >> jnp.uint32(8))
@@ -417,17 +424,35 @@ class FusedSegmentHasher:
         true counts overflowed the compiled tables (adversarial data)."""
         handle, (cand_cap, chunk_cap) = inflight
         while True:
-            chunks, consumed, n_cand, _ = decode_segment(
-                np.asarray(handle), chunk_cap)
-            retry = False
-            if n_cand > cand_cap:
-                cand_cap = _pow2ceil(n_cand, cand_cap * 2)
-                retry = True
-            if len(chunks) >= chunk_cap and (consumed < length):
-                chunk_cap = chunk_cap * 2
-                retry = True
-            if not retry:
+            chunks, consumed, grown = decode_with_overflow_check(
+                np.asarray(handle), length, cand_cap, chunk_cap)
+            if grown is None:
                 return chunks, consumed
+            cand_cap, chunk_cap = grown
             handle, (cand_cap, chunk_cap) = self.dispatch(
                 dev, length, eof=eof, cand_cap=cand_cap,
                 chunk_cap=chunk_cap)
+
+
+def decode_with_overflow_check(packed: np.ndarray, length: int,
+                               cand_cap: int, chunk_cap: int):
+    """Decode one packed result and apply the capacity-retry protocol.
+
+    Returns (chunks, consumed, grown): ``grown`` is None when the
+    result is trustworthy, else the (cand_cap, chunk_cap) to re-dispatch
+    with. The in-band header makes truncation always detectable: slot 2
+    carries the true (single-chip) / worst-shard (mesh) candidate count,
+    and a full chunk table with bytes still unconsumed means the walk
+    was cut short. Shared by FusedSegmentHasher and the mesh path so the
+    protocol cannot drift between the single- and multi-chip engines.
+    """
+    chunks, consumed, n_cand, _ = decode_segment(packed, chunk_cap)
+    grown_cand, grown_chunk = cand_cap, chunk_cap
+    retry = False
+    if n_cand > cand_cap:
+        grown_cand = _pow2ceil(n_cand, cand_cap * 2)
+        retry = True
+    if len(chunks) >= chunk_cap and consumed < length:
+        grown_chunk = chunk_cap * 2
+        retry = True
+    return chunks, consumed, (grown_cand, grown_chunk) if retry else None

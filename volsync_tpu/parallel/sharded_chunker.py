@@ -69,6 +69,7 @@ class MeshChunkHasher:
         self.n_shards = self.mesh.devices.size
         self._cand_cache: dict = {}
         self._leaf_cache: dict = {}
+        self._fused_cache: dict = {}
         self._jax = jax
 
     # -- public protocol (mirrors DeviceChunkHasher.process) ----------------
@@ -86,12 +87,57 @@ class MeshChunkHasher:
             return [(0, length, blobid.blob_id(buffer.tobytes()))]
 
         data, shard_len = self._upload(buffer, length)
+        if p.align == _LEAF:
+            return self._process_fused(data, shard_len, length, eof)
         idx_s, idx_l = self._candidates(data, shard_len, length)
         chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
         if not chunks:
             return []
         hexes = self._span_roots(data, shard_len, chunks)
         return [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)]
+
+    # -- fused page-aligned path (one dispatch, one small fetch) ------------
+
+    def _process_fused(self, data, shard_len: int, length: int,
+                       eof: bool) -> list[tuple[int, int, str]]:
+        """The ops/segment.py one-round-trip protocol, sharded: page
+        digests and candidates compute per shard (pages never cross
+        seams — shard_len % LEAF == 0 — so there is NO halo at all),
+        the 32-bytes-per-4KiB digest stream all-gathers over the seq
+        ring (1/128th of the data volume, riding ICI), and the FastCDC
+        walk + root assembly run replicated on the gathered table. ONE
+        replicated ~20 KiB result comes back; capacity overflows are
+        reported in-band and retried with doubled tables, exactly like
+        the single-chip FusedSegmentHasher."""
+        from volsync_tpu.ops.segment import (
+            decode_with_overflow_check,
+            segment_caps,
+        )
+
+        padded = self.n_shards * shard_len
+        cand_cap, chunk_cap = segment_caps(padded, self.params)
+        # cand_cap is per shard in this path (compaction is local; the
+        # header's candidate slot carries the WORST shard's true count).
+        cand_cap = max(1024, cand_cap // self.n_shards)
+        while True:
+            fn = self._fused_fn(shard_len, cand_cap, chunk_cap, eof)
+            packed = np.asarray(fn(data, np.int32(length)))
+            chunks, consumed, grown = decode_with_overflow_check(
+                packed, length, cand_cap, chunk_cap)
+            if grown is None:
+                assert not eof or consumed == length
+                return chunks
+            cand_cap, chunk_cap = grown
+
+    def _fused_fn(self, shard_len: int, cand_cap: int, chunk_cap: int,
+                  eof: bool):
+        key = (shard_len, cand_cap, chunk_cap, eof)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = _build_fused_fn(self.mesh, self.params, shard_len,
+                                 cand_cap, chunk_cap, eof)
+            self._fused_cache[key] = fn
+        return fn
 
     # -- upload -------------------------------------------------------------
 
@@ -226,6 +272,130 @@ class MeshChunkHasher:
             leaves = [leaf_bytes(*placement[first + k]) for k in range(n)]
             out.append(blobid.root_from_leaves(clen, leaves))
         return out
+
+
+def _build_fused_fn(mesh, params: GearParams, shard_len: int,
+                    cand_cap: int, chunk_cap: int, eof: bool):
+    """shard_map kernel for the fused page-aligned segment protocol.
+
+    Layout: data [S, Ls] with shard i holding bytes [i*Ls, (i+1)*Ls);
+    Ls % LEAF == 0, so pages (== full Merkle leaves, align == LEAF)
+    never cross seams and per-shard page hashing needs no collective.
+    Per shard: page digests (ops/segment._page_digests_flat — the
+    Pallas transpose + SHA lane kernel on TPU, the XLA scan on CPU) and
+    aligned gear candidates. Then: all_gather of the digest words and
+    the compacted candidate lists (sentinel-padded, re-sorted), psum'd
+    counts, and the ops/segment walk + root loop on the replicated
+    tables — every shard computes the identical ~20 KiB packed result.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.ops.gearcdc import gear_at_aligned
+    from volsync_tpu.ops.segment import (
+        _page_digests_flat,
+        _root_digests_loop,
+        _select_boundaries_device,
+    )
+    from volsync_tpu.ops.sha256 import (
+        _LANE_TILE,
+        sha256_chunks_device,
+        use_pallas_leaves,
+    )
+
+    p = params
+    S = mesh.devices.size
+    align = p.align
+    npp = shard_len // _LEAF  # real pages per shard
+    npps = ((npp + _LANE_TILE - 1) // _LANE_TILE * _LANE_TILE
+            if use_pallas_leaves() else npp)  # padded (Pallas lane grid)
+    R = shard_len // align
+    mask_s = np.uint32(p.mask_s)
+    mask_l = np.uint32(p.mask_l)
+    sentinel = jnp.int32(2**31 - 2)
+
+    def local(data, valid_len):  # data: [1, Ls]
+        i = jax.lax.axis_index(SEQ)
+        row = data[0]
+        valid_len = valid_len.astype(jnp.int32)
+
+        # --- per-shard page digests (no halo: pages don't cross seams)
+        flat_local = _page_digests_flat(row, npps)  # [8 * npps]
+        flat_g = jax.lax.all_gather(flat_local, SEQ, axis=0)  # [S, 8*npps]
+        flat_g = flat_g.reshape(S * 8 * npps)
+
+        def word_index(j, page):  # word j of GLOBAL page p
+            return (page // npp) * (8 * npps) + j * npps + page % npp
+
+        # --- per-shard aligned candidates -> global sorted tables
+        h = gear_at_aligned(row, p.seed, align)  # [R]
+        pos = (i * shard_len
+               + jnp.arange(R, dtype=jnp.int32) * align + (align - 1))
+        ok = pos < valid_len
+        is_s = ((h & mask_s) == 0) & ok
+        is_l = ((h & mask_l) == 0) & ok
+        ridx_l = jnp.nonzero(is_l, size=cand_cap, fill_value=R)[0]
+        safe = jnp.clip(ridx_l, 0, R - 1)
+        lpos = jnp.where(ridx_l < R, pos[safe], sentinel)
+        lstrict = jnp.where(ridx_l < R, is_s[safe], False)
+        spos = jnp.where(lstrict, lpos, sentinel)
+        pos_l = jnp.sort(jax.lax.all_gather(lpos, SEQ, axis=0).reshape(-1))
+        pos_s = jnp.sort(jax.lax.all_gather(spos, SEQ, axis=0).reshape(-1))
+        nl = jax.lax.psum(jnp.sum(is_l).astype(jnp.int32), SEQ)
+        ns = jax.lax.psum(jnp.sum(is_s).astype(jnp.int32), SEQ)
+        worst = jax.lax.pmax(jnp.sum(is_l).astype(jnp.int32), SEQ)
+
+        # --- replicated FastCDC walk
+        starts, lens, count, consumed = _select_boundaries_device(
+            pos_s, jnp.minimum(ns, S * cand_cap),
+            pos_l, jnp.minimum(nl, S * cand_cap),
+            valid_len, min_size=p.min_size, avg_size=p.avg_size,
+            max_size=p.max_size, chunk_cap=chunk_cap, eof=eof)
+
+        # --- the ONE possibly-partial tail leaf: hashed by its owner
+        # shard, psum-broadcast, spliced into the gathered table.
+        live = jnp.arange(chunk_cap, dtype=jnp.int32) < count
+        end = jnp.where(count > 0,
+                        starts[jnp.maximum(count - 1, 0)]
+                        + lens[jnp.maximum(count - 1, 0)], 0)
+        has_tail = (count > 0) & (end % _LEAF != 0)
+        tail_page = jnp.maximum(end - 1, 0) // _LEAF
+        tail_len = end - tail_page * _LEAF
+        owner = tail_page // npp
+        loc_off = (tail_page % npp) * _LEAF
+        mine = has_tail & (owner == i)
+        t_dig = sha256_chunks_device(
+            row, loc_off[None], jnp.where(mine, tail_len, 0)[None],
+            max_len=_LEAF)[0]
+        t_dig = jax.lax.psum(
+            jnp.where(mine, t_dig, jnp.uint32(0)), SEQ)
+        ovr = jnp.where(has_tail,
+                        word_index(jnp.arange(8, dtype=jnp.int32),
+                                   tail_page),
+                        S * 8 * npps)  # OOB -> dropped
+        flat_g = flat_g.at[ovr].set(t_dig, mode="drop")
+
+        # --- replicated roots + packed result
+        nleaves = jnp.where(live, (lens + (_LEAF - 1)) // _LEAF, 0)
+        page0 = starts // _LEAF
+        roots = _root_digests_loop(flat_g, S * npp, page0, nleaves, lens,
+                                   live, word_index=word_index)
+        header = jnp.stack([count.astype(jnp.uint32),
+                            consumed.astype(jnp.uint32),
+                            worst.astype(jnp.uint32),
+                            jnp.sum(nleaves).astype(jnp.uint32)])
+        return jnp.concatenate([header, starts.astype(jnp.uint32),
+                                lens.astype(jnp.uint32), roots.reshape(-1)])
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
 
 
 def _build_cand_fn(mesh, params: GearParams, shard_len: int, cap: int):
