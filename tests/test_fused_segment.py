@@ -132,3 +132,59 @@ def test_small_and_empty_buffers():
     tiny = b"x" * 100  # <= min_size: host fast path
     [(s, l, d)] = h.process(tiny)
     assert (s, l) == (0, 100) and d == blobid.blob_id(tiny)
+
+
+def test_hash_spans_page_aligned_fast_path(rng):
+    """Aligned spans take span_roots_device (one dispatch/fetch) and
+    must match blob_id exactly — including empty files, exact-page
+    sizes, and sub-page tails."""
+    from volsync_tpu.engine.chunker import hash_spans
+
+    sizes = [0, 1, 4095, 4096, 4097, 12288, 50_000]
+    pieces, spans = [], []
+    off = 0
+    for n in sizes:
+        data = rng.randint(0, 256, size=(n,), dtype=np.uint8).tobytes()
+        spans.append((off, n))
+        pieces.append(data)
+        pad = -n % 4096
+        pieces.append(bytes(pad))
+        off += n + pad
+    buf = b"".join(pieces)
+    got = hash_spans(buf, spans)
+    for (s, l), d in zip(spans, got):
+        assert d == blobid.blob_id(buf[s: s + l]), f"span {s},{l}"
+
+
+def test_hash_spans_unaligned_fallback(rng):
+    from volsync_tpu.engine.chunker import hash_spans
+
+    buf = rng.randint(0, 256, size=(40_000,), dtype=np.uint8).tobytes()
+    spans = [(0, 10_000), (10_000, 30_000)]  # second start unaligned
+    got = hash_spans(buf, spans)
+    for (s, l), d in zip(spans, got):
+        assert d == blobid.blob_id(buf[s: s + l])
+
+
+def test_hash_file_streaming_page_path(tmp_path, rng):
+    from volsync_tpu.engine.chunker import hash_file_streaming
+
+    for n in (0, 5, 4096, 200_000, 1_048_576 + 123):
+        p = tmp_path / f"f{n}"
+        data = rng.randint(0, 256, size=(n,), dtype=np.uint8).tobytes()
+        p.write_bytes(data)
+        assert hash_file_streaming(p, segment_size=256 * 1024) \
+            == blobid.blob_id(data), n
+
+
+def test_hash_spans_overlapping_aligned_fallback(rng):
+    """Overlapping page-aligned spans (reachable via the gRPC HashSpans
+    endpoint) must NOT take the shared-table fast path — its in-place
+    tail override would corrupt the page both spans read."""
+    from volsync_tpu.engine.chunker import hash_spans
+
+    buf = rng.randint(0, 256, size=(8192,), dtype=np.uint8).tobytes()
+    spans = [(0, 100), (0, 8192), (4096, 100)]
+    got = hash_spans(buf, spans)
+    for (s, l), d in zip(spans, got):
+        assert d == blobid.blob_id(buf[s: s + l])

@@ -409,23 +409,76 @@ def _upload_padded(buffer):
     return jnp.asarray(buffer)
 
 
+def _spans_page_disjoint(spans: list[tuple[int, int]]) -> bool:
+    """True iff every span starts on the 4 KiB page grid and no two
+    spans touch the same page — the precondition for the shared
+    page-digest table in ops/segment.span_roots_device (its per-span
+    tail override mutates that table in place). Zero-length spans touch
+    no pages (they're hashed host-side)."""
+    last_page = -1
+    for s, l in sorted(spans):
+        if s % blobid.LEAF_SIZE != 0:
+            return False
+        if l <= 0:
+            continue
+        if s // blobid.LEAF_SIZE <= last_page:
+            return False
+        last_page = (s + l - 1) // blobid.LEAF_SIZE
+    return True
+
+
 def hash_spans(buffer, spans: list[tuple[int, int]]) -> list[str]:
     """Device-batched blob ids for (start, length) spans of one buffer.
 
     The checksum-compare primitive for the rclone-style mover (the
-    reference's `rclone sync --checksum`, mover-rclone/active.sh:19):
-    many files are packed into one host buffer, uploaded once, and every
-    4 KiB leaf of every span hashes as an independent lane.
+    reference's `rclone sync --checksum`, mover-rclone/active.sh:19).
+    When every span start is 4 KiB-aligned (the mover's packer pads to
+    the page grid), this is ONE fused dispatch + ONE [N, 8] fetch:
+    all full leaves are pages of the buffer (contiguous hashing, no
+    gather) and only each span's short tail pays the gather path
+    (ops/segment.span_roots_device). Unaligned spans fall back to the
+    generic per-leaf gather batch.
     """
     if not spans:
         return []
+    if _spans_page_disjoint(spans):
+        import jax.numpy as jnp
+
+        from volsync_tpu.ops.segment import span_roots_device
+
+        n_cap = _pow2ceil(len(spans), 128)
+        starts = np.full((n_cap,), 0, np.int32)
+        lengths = np.full((n_cap,), -1, np.int32)  # padding lanes
+        starts[: len(spans)] = [s for s, _ in spans]
+        lengths[: len(spans)] = [l for _, l in spans]
+        # Zero-length spans consume no pages, so their device tail
+        # override would collide with whatever span owns that page —
+        # their id is a constant anyway.
+        empty = lengths[: len(spans)] == 0
+        lengths[: len(spans)][empty] = -1
+        roots = np.asarray(span_roots_device(
+            _upload_padded(buffer), jnp.asarray(starts),
+            jnp.asarray(lengths))).astype(">u4")
+        empty_id = blobid.blob_id(b"")
+        return [empty_id if empty[i] else roots[i].tobytes().hex()
+                for i in range(len(spans))]
     return device_span_roots(_upload_padded(buffer), spans)
 
 
 def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
     """Blob id of an arbitrarily large file with bounded memory: leaf
     digests are computed on device one ~32 MiB segment at a time and the
-    root combines host-side (repo/blobid.py)."""
+    root combines host-side (repo/blobid.py).
+
+    Every leaf of a whole-file stream is a PAGE of its segment
+    (segment_size % 4 KiB == 0), so the device hashes pages contiguously
+    (ops/segment._page_digests_flat — no gather) and only the file's
+    final partial leaf is hashed host-side from bytes already in hand.
+    One digest fetch per segment, 32 bytes per 4 KiB."""
+    import hashlib
+
+    from volsync_tpu.ops.segment import page_digests
+
     assert segment_size % blobid.LEAF_SIZE == 0
     leaves: list[bytes] = []
     total = 0
@@ -435,11 +488,14 @@ def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
             if not seg:
                 break
             total += len(seg)
-            dev = _upload_padded(seg)
-            n = blobid.leaf_count(len(seg))
-            starts = [k * blobid.LEAF_SIZE for k in range(n)]
-            lengths = [min(blobid.LEAF_SIZE, len(seg) - s) for s in starts]
-            leaves.extend(device_leaf_digests(dev, starts, lengths))
+            full = len(seg) // blobid.LEAF_SIZE
+            if full:
+                dev = _upload_padded(seg[: full * blobid.LEAF_SIZE])
+                dig = page_digests(dev)[:full].astype(">u4")
+                leaves.extend(dig[k].tobytes() for k in range(full))
+            tail = seg[full * blobid.LEAF_SIZE:]
+            if tail:
+                leaves.append(hashlib.sha256(tail).digest())
     if total == 0:
         return blobid.blob_id(b"")
     return blobid.root_from_leaves(total, leaves)

@@ -203,12 +203,21 @@ def hash_files(root: Path, rels: list[str]) -> dict[str, str]:
         nonlocal batch, batch_bytes
         if not batch:
             return
-        buf = b"".join(data for _, data in batch)
+        # Files pack at 4 KiB-aligned offsets (<=4095B zero fill each),
+        # which puts every Merkle leaf on the buffer's page grid — the
+        # hash_spans fused fast path (ops/segment.span_roots_device):
+        # one dispatch + one [N, 8] fetch, no per-leaf gathers.
+        pieces: list[bytes] = []
         spans = []
         off = 0
         for _, data in batch:
             spans.append((off, len(data)))
-            off += len(data)
+            pieces.append(data)
+            pad = -len(data) % 4096
+            if pad:
+                pieces.append(bytes(pad))
+            off += len(data) + pad
+        buf = b"".join(pieces)
         for (rel, _), digest in zip(batch, hash_spans(buf, spans)):
             out[rel] = digest
         batch, batch_bytes = [], 0

@@ -133,6 +133,16 @@ def _select_boundaries_device(pos_s, ns, pos_l, nl, valid_len, *,
 # Page-digest stage: contiguous leaf hashing, no gathers
 # ---------------------------------------------------------------------------
 
+def _n_pages_pad(F: int) -> int:
+    """Page count padded for the Pallas lane grid (identity on CPU).
+    The single source of truth — chunk_hash_segment, page_digests, and
+    span_roots_device must agree or their word-major indexing into
+    _page_digests_flat desynchronizes."""
+    if not use_pallas_leaves():
+        return F
+    return max(_LANE_TILE, (F + _LANE_TILE - 1) // _LANE_TILE * _LANE_TILE)
+
+
 def _transpose_kernel(x_ref, o_ref):
     o_ref[...] = x_ref[...].T
 
@@ -309,9 +319,7 @@ def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
     P = data.shape[0]
     R = P // align
     F = P // LEAF_SIZE
-    n_pages_pad = max(_LANE_TILE, (F + _LANE_TILE - 1)
-                      // _LANE_TILE * _LANE_TILE) \
-        if use_pallas_leaves() else F
+    n_pages_pad = _n_pages_pad(F)
     valid_len = jnp.asarray(valid_len, jnp.int32)
 
     # --- candidates (aligned gear evaluation, as cdc_candidates_aligned)
@@ -372,6 +380,80 @@ def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
     return jnp.concatenate([
         header, starts.astype(jnp.uint32), lens.astype(jnp.uint32),
         roots.reshape(-1)])
+
+
+@functools.partial(jax.jit, static_argnames=("n_pages_pad",))
+def _page_digests_jit(data, n_pages_pad: int):
+    return _page_digests_flat(data, n_pages_pad)
+
+
+def page_digests(dev) -> np.ndarray:
+    """SHA-256 of every full 4 KiB page of a resident buffer ->
+    [P/4096, 8] big-endian-word ndarray (one dispatch, one fetch of
+    32 bytes per page). The streaming whole-file hasher's primitive."""
+    P = int(dev.shape[0])
+    F = P // LEAF_SIZE
+    npps = _n_pages_pad(F)
+    flat = np.asarray(_page_digests_jit(dev, npps))
+    return flat.reshape(8, npps).T[:F]
+
+
+@jax.jit
+def span_roots_device(data: jax.Array, starts: jax.Array,
+                      lens: jax.Array) -> jax.Array:
+    """Blob ids for page-aligned spans of a resident buffer, ONE fetch.
+
+    data: [P] uint8, P % LEAF_SIZE == 0; starts/lens: [N] int32 with
+    every start % LEAF_SIZE == 0 (padding lanes: lens < 0). Used by the
+    rclone-style checksum mover (reference: mover-rclone/active.sh:19
+    ``rclone sync --checksum``): many whole files pack into one buffer
+    at page-aligned offsets, so all full Merkle leaves are pages of the
+    buffer (hashed contiguously, no gather) and only each span's final
+    partial leaf — at most one per span — pays the gather path. Returns
+    [N, 8] uint32 roots (garbage on padding lanes).
+
+    Unlike chunk_hash_segment there is no boundary walk: the spans ARE
+    the blobs. CONTRACT: spans must be page-DISJOINT (no two spans may
+    touch the same 4 KiB page) — the tail override mutates the shared
+    page-digest table, so a page shared between spans would corrupt the
+    other span's root. That also rules out zero-length spans (they'd
+    override a page they don't own): callers mark them as padding lanes
+    (lens < 0) and emit blob_id(b"") host-side, as
+    engine/chunker.hash_spans does; its _spans_page_disjoint is the
+    matching gate.
+    """
+    P = data.shape[0]
+    F = P // LEAF_SIZE
+    n_pages_pad = _n_pages_pad(F)
+    starts = starts.astype(jnp.int32)
+    lens = lens.astype(jnp.int32)
+    # lens <= 0 lanes are inert: no tail override (they own no page —
+    # writing one would corrupt its real owner) and a garbage root.
+    live = lens > 0
+    lens_c = jnp.maximum(lens, 0)
+
+    flat = _page_digests_flat(data, n_pages_pad)
+
+    # Per-span tail leaf: the partial last page (len % LEAF != 0).
+    end = starts + lens_c
+    has_tail = live & (lens_c % LEAF_SIZE != 0)
+    tail_page = jnp.maximum(end - 1, 0) // LEAF_SIZE
+    tail_len = end - tail_page * LEAF_SIZE
+    tail_dig = sha256_chunks_device(
+        data, jnp.clip(tail_page * LEAF_SIZE, 0, P - 1),
+        jnp.where(has_tail, tail_len, 0), max_len=LEAF_SIZE)  # [n_cap, 8]
+    j8 = jnp.arange(8, dtype=jnp.int32)[None, :]
+    ovr = jnp.where(has_tail[:, None],
+                    j8 * n_pages_pad + tail_page[:, None],
+                    8 * n_pages_pad)  # OOB -> dropped
+    flat = flat.at[ovr.reshape(-1)].set(tail_dig.reshape(-1), mode="drop")
+
+    nleaves = jnp.where(live,
+                        jnp.maximum((lens_c + LEAF_SIZE - 1) // LEAF_SIZE, 1),
+                        0)
+    page0 = starts // LEAF_SIZE
+    return _root_digests_loop(flat, n_pages_pad, page0, nleaves, lens_c,
+                              live)
 
 
 def decode_segment(packed: np.ndarray, chunk_cap: int
