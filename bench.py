@@ -35,6 +35,22 @@ import time
 import numpy as np
 
 
+def _host_gear_candidates(host: np.ndarray, p) -> tuple[np.ndarray, np.ndarray]:
+    """Pure-numpy aligned gear scan -> (strict, lax) candidate cut
+    positions. The host reference for the device kernel
+    (ops/gearcdc.gear_at_aligned): table value per byte, 32-byte window
+    weighted by shifts 31..0, mod 2^32. Shared by the golden self-check
+    and the CPU baseline so the two can never desynchronize."""
+    n = host.shape[0] // p.align * p.align
+    rows = host[:n].reshape(-1, p.align)[:, -32:]
+    g = p.table[rows].astype(np.uint64)
+    shifts = np.arange(31, -1, -1, dtype=np.uint64)
+    h = ((g << shifts[None, :]).sum(axis=1) & 0xFFFFFFFF).astype(np.uint32)
+    pos = np.arange(h.shape[0], dtype=np.int64) * p.align + (p.align - 1)
+    return (pos[(h & np.uint32(p.mask_s)) == 0],
+            pos[(h & np.uint32(p.mask_l)) == 0])
+
+
 def _make_data(total: int, redundancy: float = 0.5) -> np.ndarray:
     """BASELINE.json configs[4]-style synthetic volume: ``redundancy`` of
     the stream is a repeated region (dedup finds it; boundaries/digests
@@ -56,7 +72,8 @@ def _try_device_throughput(seg_mib: int, streams: int, iters: int) -> float:
 
     p = DEFAULT_PARAMS
     n = seg_mib * 1024 * 1024
-    data = jnp.asarray(_make_data(n))
+    host_np = _make_data(n)
+    data = jnp.asarray(host_np)
     jax.block_until_ready(data)
 
     # The salt is composed INTO the one fused dispatch (d ^ s traces
@@ -99,16 +116,18 @@ def _try_device_throughput(seg_mib: int, streams: int, iters: int) -> float:
         return emitted
 
     # Warm all shapes/compiles once — and use the (unsalted) warm run as
-    # an on-TPU golden check: the fused path must agree with the legacy
-    # candidate kernel + host FastCDC walk + hashlib Merkle ids.
+    # an on-TPU golden check against a PURE-HOST reference (numpy gear
+    # scan + the scalar FastCDC walk + hashlib Merkle ids): no second
+    # device program to compile, and nothing the device computes is
+    # trusted to check itself.
     h0 = make_hasher(0)
     h0.salt = jnp.uint8(0)
     warm = h0.process_device(data, n)
-    from volsync_tpu.ops.gearcdc import chunk_buffer
+    from volsync_tpu.ops.gearcdc import _select_boundaries_py
     from volsync_tpu.repo import blobid
 
-    host_np = np.asarray(_make_data(n))
-    ref_bounds = chunk_buffer(host_np, p)
+    idx_s, idx_l = _host_gear_candidates(host_np, p)
+    ref_bounds = _select_boundaries_py(idx_s, idx_l, n, p, eof=True)
     assert [(s, l) for s, l, _ in warm] == ref_bounds, "fused boundaries"
     view = host_np.tobytes()
     for s, l, d in warm[:4] + warm[-2:]:
@@ -160,13 +179,8 @@ def cpu_baseline(total_mib: int = 64) -> float:
     p = DEFAULT_PARAMS
     n = total_mib * 1024 * 1024
     host = _make_data(n)
-    table = p.table
     t0 = time.perf_counter()
-    rows = host[: n // p.align * p.align].reshape(-1, p.align)[:, -32:]
-    g = table[rows].astype(np.uint64)
-    shifts = np.arange(31, -1, -1, dtype=np.uint64)
-    h = (g << shifts[None, :]).sum(axis=1).astype(np.uint32)
-    cand = np.nonzero((h & np.uint32(p.mask_l)) == 0)[0]
+    _, cand = _host_gear_candidates(host, p)
     view = host.tobytes()
     pos = 0
     while pos < n:
