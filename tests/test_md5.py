@@ -38,3 +38,41 @@ def test_fixed_blocks_device(rng):
     for i, s in enumerate(starts):
         want = np.frombuffer(hashlib.md5(data[s : s + 2000]).digest(), dtype="<u4")
         assert (out[i] == want).all()
+
+
+def test_md5_contiguous_blocks_matches_hashlib(rng):
+    import hashlib
+
+    import jax.numpy as jnp
+
+    from volsync_tpu.ops.md5 import md5_contiguous_blocks_device
+
+    for block_len in (4096, 8192):
+        n_blocks = 7
+        data = rng.randint(0, 256, size=(n_blocks * block_len,),
+                           dtype=np.uint8)
+        out = np.asarray(md5_contiguous_blocks_device(
+            jnp.asarray(data), block_len=block_len)).astype("<u4")
+        for b in range(n_blocks):
+            ref = hashlib.md5(
+                data[b * block_len: (b + 1) * block_len].tobytes()).digest()
+            assert out[b].tobytes() == ref, (block_len, b)
+
+
+def test_build_signature_odd_block_len_fallback(rng):
+    """Non-1024-multiple block sizes must route to the windowed kernel
+    and still match hashlib."""
+    import hashlib
+
+    import jax.numpy as jnp
+
+    from volsync_tpu.ops.delta import build_signature
+
+    block_len = 512
+    data = rng.randint(0, 256, size=(512 * 5 + 100,), dtype=np.uint8)
+    weak, strong = build_signature(jnp.asarray(data), block_len=block_len)
+    out = np.asarray(strong).astype("<u4")
+    for b in range(5):
+        ref = hashlib.md5(
+            data[b * block_len: (b + 1) * block_len].tobytes()).digest()
+        assert out[b].tobytes() == ref

@@ -24,7 +24,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from volsync_tpu.ops.md5 import md5_fixed_blocks_device
+from volsync_tpu.ops.md5 import (
+    md5_contiguous_blocks_device,
+    md5_fixed_blocks_device,
+)
 from volsync_tpu.ops.rolling import block_weak_checksums, rolling_weak_checksums
 
 
@@ -37,8 +40,19 @@ def build_signature(data: jax.Array, *, block_len: int):
     weak = block_weak_checksums(data, block_len=block_len)
     L = int(data.shape[0])
     n_full = L // block_len
-    starts = jnp.arange(n_full, dtype=jnp.int32) * block_len
-    strong = md5_fixed_blocks_device(data, starts, block_len=block_len)
+    if block_len % 1024 == 0:
+        # The destination's blocks tile the file contiguously: the
+        # strong checksums take the gather-free transposed-lane path
+        # (pick_block_len sizes are always eligible; the windowed
+        # gather kernel stays for sparse match verification and for
+        # caller-chosen odd block sizes).
+        strong = md5_contiguous_blocks_device(
+            jax.lax.slice_in_dim(data, 0, n_full * block_len),
+            block_len=block_len)
+    else:
+        starts = jnp.arange(n_full, dtype=jnp.int32) * block_len
+        strong = md5_fixed_blocks_device(data, starts,
+                                         block_len=block_len)
     return weak, strong
 
 

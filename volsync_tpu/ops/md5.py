@@ -197,3 +197,62 @@ def md5_fixed_blocks_device(data: jax.Array, starts: jax.Array,
     )
     nb = jnp.full((B,), N, dtype=jnp.int32)
     return md5_blocks(blocks, nb)
+
+
+@functools.partial(jax.jit, static_argnames=("block_len",))
+def md5_contiguous_blocks_device(data: jax.Array, *,
+                                 block_len: int) -> jax.Array:
+    """MD5 of every contiguous ``block_len`` window of ``data``
+    ([L] uint8, L % block_len == 0) -> [L/block_len, 4] uint32 states.
+
+    The delta signature's bulk path (engine/deltasync.build_signature:
+    the destination's blocks tile its file, so its strong checksums
+    never need the windowed gather of md5_fixed_blocks_device, which is
+    reserved for sparse match verification). TPU-fast by construction
+    (docs/performance.md op classes): little-endian words pack via 2-D
+    minor-dim strides, a Pallas tile-transpose puts blocks on the lane
+    axis, and the per-64-byte-block scan takes row slices of the
+    transposed table — no data-sized XLA gather or transpose anywhere.
+    block_len must be a multiple of 1024 (the Pallas transpose tiles
+    256 word columns; pick_block_len yields pow2 >= 4 KiB) — the
+    build_signature wrapper falls back to the windowed kernel for other
+    sizes.
+    """
+    assert block_len % 1024 == 0, "fast path needs 256-word columns"
+    from volsync_tpu.ops.sha256 import pack_words_rows
+
+    L = data.shape[0]
+    B = L // block_len
+    r = data.reshape(B, block_len)
+    w = pack_words_rows(r, little_endian=True)  # [B, W] LE words
+
+    if jax.default_backend() == "cpu":
+        xt = jnp.transpose(w, (1, 0))  # XLA transpose is fine on CPU
+        Bp = B
+    else:
+        from volsync_tpu.ops.segment import _pallas_transpose
+
+        Bp = (B + 255) // 256 * 256
+        if Bp != B:
+            w = jnp.pad(w, ((0, Bp - B), (0, 0)))
+        xt = _pallas_transpose(w)  # [W, Bp]
+
+    state0 = jnp.broadcast_to(jnp.asarray(_A0), (Bp, 4))
+
+    def step(state, t):
+        m = jnp.stack(
+            [jax.lax.dynamic_index_in_dim(xt, t * 16 + j, 0, False)
+             for j in range(16)], axis=-1)  # [Bp, 16]
+        return _compress(state, m), None
+
+    state, _ = jax.lax.scan(step, state0,
+                            jnp.arange(block_len // 64, dtype=jnp.int32))
+    # FIPS pad for a fixed full-length message: one constant extra block
+    # (0x80 terminator then the 64-bit LE bit length).
+    pad = np.zeros((16,), dtype=np.uint32)
+    pad[0] = 0x80
+    bitlen = block_len * 8
+    pad[14] = bitlen & 0xFFFFFFFF
+    pad[15] = (bitlen >> 32) & 0xFFFFFFFF
+    pad_block = jnp.broadcast_to(jnp.asarray(pad), (Bp, 16))
+    return _compress(state, pad_block)[:B]
