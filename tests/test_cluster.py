@@ -181,3 +181,14 @@ def test_late_binding_chain(cluster):
     assert root.status.phase == "Bound"
     assert cluster.get("VolumeSnapshot", "ns", "s").status.ready_to_use
     assert cluster.get("Volume", "ns", "r").status.phase == "Bound"
+
+
+def test_multihost_init_single_process():
+    """Single-host: init_distributed is a safe no-op returning a sane
+    summary, and is idempotent."""
+    from volsync_tpu.parallel.multihost import init_distributed
+
+    info = init_distributed()
+    assert info["process_count"] >= 1
+    assert info["global_devices"] >= info["local_devices"] >= 1
+    assert init_distributed() == info  # idempotent

@@ -42,6 +42,9 @@ FLAGS = [
      "comma-separated movers to register (registerMovers main.go:67-81)"),
     ("scc-name", "VOLSYNC_SCC_NAME", "volsync-mover", str,
      "runner-policy name granted to per-CR identities (sahandler.go:32-36)"),
+    ("distributed", "VOLSYNC_DISTRIBUTED", 0, int,
+     "initialize jax.distributed for a multi-host pod-slice mesh "
+     "(parallel/multihost.py); 0 = single-host"),
 ]
 
 
@@ -163,6 +166,13 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     args = build_parser().parse_args(argv)
     cfg = resolve_config(args)
+    if cfg["distributed"]:
+        from volsync_tpu.parallel.multihost import init_distributed
+
+        info = init_distributed()
+        log.info("jax.distributed: process %d/%d, %d local / %d global "
+                 "devices", info["process_index"], info["process_count"],
+                 info["local_devices"], info["global_devices"])
     rt = OperatorRuntime(cfg).start()
     movers = ", ".join(rt.catalog.names())
     log.info("volsync-tpu operator up: movers=[%s] node=%s storage=%s",
