@@ -39,9 +39,19 @@ def init_distributed(coordinator_address: Optional[str] = None,
     (process_index, process_count, local/global device counts) for the
     operator's startup log. Idempotent: calling twice is a no-op.
     """
+    import logging
+
     import jax
 
-    if getattr(init_distributed, "_done", False):
+    log = logging.getLogger("volsync.multihost")
+    args = (coordinator_address, num_processes, process_id)
+    prev = getattr(init_distributed, "_done_args", None)
+    if prev is not None:
+        if prev != args:
+            raise RuntimeError(
+                f"init_distributed already ran with {prev}; cannot "
+                f"re-initialize with {args} (jax.distributed is "
+                "once-per-process)")
         return _summary(jax)
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
@@ -50,17 +60,26 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address or num_processes is not None:
+        # Explicit multi-host configuration: failures must propagate —
+        # a worker silently degrading to single-host would leave its
+        # peers blocked at the coordinator barrier.
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
     else:
-        # TPU pod slices self-describe; initialize() with no args uses
-        # the platform's cluster-detection (a no-op on single host).
+        # No explicit configuration: TPU pod slices self-describe, and
+        # single-host/CPU environments raise — treat that as "nothing
+        # to join" but say so, since on a real slice it means this
+        # worker is about to run alone while peers wait.
         try:
             jax.distributed.initialize()
-        except Exception:  # noqa: BLE001 — single-host/CPU: nothing to do
-            pass
-    init_distributed._done = True
+        except Exception as e:  # noqa: BLE001
+            log.warning(
+                "jax.distributed auto-detection unavailable (%s) — "
+                "continuing single-host; on a pod slice set "
+                "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
+                "JAX_PROCESS_ID explicitly", e)
+    init_distributed._done_args = args
     return _summary(jax)
 
 
