@@ -172,3 +172,68 @@ def test_misconfigured_spec_surfaces_error(world):
         (cr := cluster.try_get("ReplicationSource", "default", "broken"))
         and cr.status and any(
             c.reason == "Error" for c in cr.status.conditions)))
+
+
+def test_point_in_time_restore_selectors(world):
+    """The reference's test_restic_restore_previous / restoreAsOf
+    playbooks: three backups of evolving content, then destinations
+    selecting (a) previous=1 (one before latest) and (b) restoreAsOf a
+    timestamp between backup 1 and 2 — each restored image must hold
+    exactly that epoch's content."""
+    import pathlib
+    from datetime import datetime, timezone
+
+    cluster, tmp_path = world
+    vol = make_volume(cluster, "app-data", {"f.txt": b"epoch-1"})
+    repo_secret(cluster, tmp_path)
+    root = pathlib.Path(vol.status.path)
+
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="backup", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="app-data",
+            trigger=ReplicationTrigger(manual="s1"),
+            restic=ReplicationSourceResticSpec(
+                repository="repo-secret", copy_method=CopyMethod.SNAPSHOT),
+        ),
+    )
+    cluster.create(rs)
+
+    def backed_up(tag):
+        return lambda: (
+            (cr := cluster.try_get("ReplicationSource", "default", "backup"))
+            and cr.status and cr.status.last_manual_sync == tag)
+
+    wait(cluster, backed_up("s1"))
+    t_between = datetime.now(timezone.utc)
+    time.sleep(0.05)
+
+    for tag, content in (("s2", b"epoch-2"), ("s3", b"epoch-3")):
+        (root / "f.txt").write_bytes(content)
+        cr = cluster.get("ReplicationSource", "default", "backup")
+        cr.spec.trigger.manual = tag
+        cluster.update(cr)
+        wait(cluster, backed_up(tag))
+
+    def restore(name, **sel):
+        rd = ReplicationDestination(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=ReplicationDestinationSpec(
+                trigger=ReplicationTrigger(manual="go"),
+                restic=ReplicationDestinationResticSpec(
+                    repository="repo-secret",
+                    copy_method=CopyMethod.SNAPSHOT, **sel),
+            ),
+        )
+        cluster.create(rd)
+        wait(cluster, lambda: (
+            (cr := cluster.try_get("ReplicationDestination", "default", name))
+            and cr.status and cr.status.last_manual_sync == "go"))
+        cr = cluster.get("ReplicationDestination", "default", name)
+        snap = cluster.get("VolumeSnapshot", "default",
+                           cr.status.latest_image.name)
+        return (pathlib.Path(snap.status.bound_content) / "f.txt").read_bytes()
+
+    assert restore("r-latest") == b"epoch-3"
+    assert restore("r-prev", previous=1) == b"epoch-2"
+    assert restore("r-asof", restore_as_of=t_between) == b"epoch-1"
