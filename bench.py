@@ -143,7 +143,7 @@ def _try_device_throughput(seg_mib: int, streams: int, iters: int) -> float:
     return streams * iters * n / dt  # bytes/s, full shipped path
 
 
-def device_throughput() -> float:
+def _run_config_ladder() -> float:
     configs = [(256, 8, 3), (128, 8, 4), (64, 8, 6)]
     if os.environ.get("VOLSYNC_BENCH_CONFIG"):
         seg, st, it = map(int, os.environ["VOLSYNC_BENCH_CONFIG"].split(","))
@@ -164,6 +164,27 @@ def device_throughput() -> float:
                   file=sys.stderr, flush=True)
             last_err = e
     raise last_err
+
+
+def device_throughput() -> float:
+    try:
+        return _run_config_ladder()
+    except AssertionError as e:
+        if os.environ.get("VOLSYNC_NO_PALLAS"):
+            raise  # already on the XLA path: the math itself is wrong
+        # A golden-check failure with Pallas enabled points at the
+        # Mosaic kernels on this toolchain; the XLA scan path computes
+        # identical digests by construction (golden-tested on CPU), so
+        # retry once on it — a slower HONEST number beats no number,
+        # and the stderr line flags the kernel bug for follow-up.
+        print(f"bench: golden check failed with Pallas enabled ({e}); "
+              f"retrying on the XLA path (VOLSYNC_NO_PALLAS=1)",
+              file=sys.stderr, flush=True)
+        os.environ["VOLSYNC_NO_PALLAS"] = "1"
+        import jax
+
+        jax.clear_caches()  # cached executables still contain Pallas
+        return _run_config_ladder()
 
 
 def cpu_baseline(total_mib: int = 64) -> float:
