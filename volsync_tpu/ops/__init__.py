@@ -29,8 +29,18 @@ from volsync_tpu.ops.rolling import (
     rolling_weak_checksums,
 )
 from volsync_tpu.ops.delta import build_signature, match_offsets
+from volsync_tpu.ops.segment import (
+    FusedSegmentHasher,
+    chunk_hash_segment,
+    page_digests,
+    span_roots_device,
+)
 
 __all__ = [
+    "FusedSegmentHasher",
+    "chunk_hash_segment",
+    "page_digests",
+    "span_roots_device",
     "sha256_blocks",
     "sha256_many",
     "sha256_pack_host",
