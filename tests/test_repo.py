@@ -331,3 +331,42 @@ def test_lock_contenders_back_out_and_one_proceeds():
     assert not t.is_alive()
     assert order == ["holder-out", "waiter-in"]
     assert list(repo.store.list("locks/")) == []
+
+
+def test_parallel_backup_bit_identical_and_consistent(tmp_path, rng):
+    """Worker-pool hashing must produce the identical snapshot id as the
+    serial path (tree assembly is order-independent), dedup concurrent
+    identical files exactly once, and keep stats consistent."""
+    import shutil
+
+    from volsync_tpu.engine.backup import TreeBackup
+    from volsync_tpu.objstore import FsObjectStore
+    from volsync_tpu.repo.repository import Repository
+
+    src = tmp_path / "vol"
+    src.mkdir()
+    big = rng.bytes(700_000)
+    for i in range(6):
+        d = src / f"d{i % 2}"
+        d.mkdir(exist_ok=True)
+        (d / f"f{i}.bin").write_bytes(big)          # 6 identical files
+    (src / "small.txt").write_bytes(b"tiny")
+    (src / "empty").write_bytes(b"")
+
+    def snap(workers):
+        root = tmp_path / f"repo-w{workers}"
+        repo = Repository.init(FsObjectStore(root))
+        sid, stats = TreeBackup(repo, workers=workers).run(src)
+        assert repo.check() == []
+        tree = dict(repo.list_snapshots())[sid]["tree"]
+        return tree, stats, root
+
+    # Snapshot ids embed wall time; the TREE id is the content identity.
+    tree1, stats1, _ = snap(1)
+    tree4, stats4, root4 = snap(4)
+    assert tree1 == tree4
+    # identical content stored once, regardless of worker interleaving
+    assert stats4.blobs_new + stats4.blobs_dedup \
+        == stats1.blobs_new + stats1.blobs_dedup
+    assert stats4.bytes_scanned == stats1.bytes_scanned == 6 * 700_000 + 4
+    shutil.rmtree(root4)

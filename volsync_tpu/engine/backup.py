@@ -50,11 +50,21 @@ def _load_parent_files(repo: Repository, parent_tree: str,
 
 class TreeBackup:
     def __init__(self, repo: Repository, *, skip_if_empty: bool = True,
-                 hasher=None):
+                 hasher=None, workers: Optional[int] = None):
         """``hasher`` swaps the chunk+hash engine: single-chip
         DeviceChunkHasher (default) or the mesh-sharded
         parallel.sharded_chunker.MeshChunkHasher — both produce
-        bit-identical chunks/ids, so snapshots are interchangeable."""
+        bit-identical chunks/ids, so snapshots are interchangeable.
+
+        ``workers`` hashes that many FILES concurrently (default 4, env
+        VOLSYNC_BACKUP_WORKERS). Files are independent streams, so their
+        per-segment result round-trips overlap while the device
+        serializes their kernels — the same concurrency the reference
+        gets from parallel mover pods (MaxConcurrentReconciles), here
+        inside one backup. Snapshot bits are identical for any worker
+        count: tree assembly is deterministic and the repository dedups
+        concurrent identical blobs under its lock.
+        """
         self.repo = repo
         want = params_from_config(repo.chunker_params)
         self.hasher = hasher or DeviceChunkHasher(want)
@@ -67,6 +77,14 @@ class TreeBackup:
                 f"hasher params {self.params} != repository chunker "
                 f"params {want}")
         self.skip_if_empty = skip_if_empty
+        if workers is None:
+            workers = int(os.environ.get("VOLSYNC_BACKUP_WORKERS", "4"))
+        # A hasher that doesn't declare thread-safety (the mesh-sharded
+        # engine: collective enqueue order must match across devices)
+        # forces serial file hashing regardless of the knob.
+        if not getattr(self.hasher, "thread_safe", False):
+            workers = 1
+        self.workers = max(1, workers)
 
     def run(self, root, *, hostname: str = "volsync",
             tags: Optional[list] = None,
@@ -102,7 +120,24 @@ class TreeBackup:
                     self.repo, parent_manifest["tree"])
         if self.skip_if_empty and not any(root.iterdir()):
             return None, stats
-        tree_id = self._backup_dir(root, "", parent_files, stats)
+        # Single-threaded walk (stats + unchanged-file dedup decisions),
+        # concurrent per-file hashing, deterministic tree assembly.
+        jobs: list[tuple[Path, str, object]] = []
+        skeleton = self._walk_dir(root, "", parent_files, stats, jobs)
+        contents: dict = {}
+        if jobs:
+            if self.workers > 1 and len(jobs) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(self.workers) as pool:
+                    for rel, resolved in pool.map(
+                            lambda j: self._hash_file(*j, stats), jobs):
+                        contents[rel] = resolved
+            else:
+                for j in jobs:
+                    rel, resolved = self._hash_file(*j, stats)
+                    contents[rel] = resolved
+        tree_id = self._assemble_tree(skeleton, contents, stats)
         manifest = {
             "hostname": hostname,
             "paths": [str(root)],
@@ -121,8 +156,14 @@ class TreeBackup:
 
     # -- internals ----------------------------------------------------------
 
-    def _backup_dir(self, dirpath: Path, rel: str, parent_files: dict,
-                    stats: BackupStats) -> str:
+    def _walk_dir(self, dirpath: Path, rel: str, parent_files: dict,
+                  stats: BackupStats, jobs: list) -> dict:
+        """Single-threaded walk -> a skeleton tree. File entries that
+        need hashing carry content=None and append a job; unchanged
+        files resolve to the parent's content list immediately. All
+        stats counted here (except per-blob counts, which the
+        repository updates under its own lock) so worker threads never
+        touch the shared counters."""
         entries = []
         for child in sorted(dirpath.iterdir(), key=lambda p: p.name):
             st = child.lstat()
@@ -132,51 +173,94 @@ class TreeBackup:
                 entries.append({**meta, "type": "symlink",
                                 "target": os.readlink(child)})
             elif stat_mod.S_ISDIR(st.st_mode):
-                sub = self._backup_dir(child, f"{rel}{child.name}/",
-                                       parent_files, stats)
-                entries.append({**meta, "type": "dir", "subtree": sub})
+                sub = self._walk_dir(child, f"{rel}{child.name}/",
+                                     parent_files, stats, jobs)
+                entries.append({**meta, "type": "dir", "skeleton": sub})
             elif stat_mod.S_ISREG(st.st_mode):
+                frel = f"{rel}{child.name}"
+                stats.files += 1
+                stats.bytes_scanned += st.st_size
+                prev = parent_files.get(frel)
+                if (prev is not None and prev["size"] == st.st_size
+                        and prev["mtime_ns"] == st.st_mtime_ns
+                        and all(self.repo.has_blob(b)
+                                for b in prev["content"])):
+                    stats.blobs_dedup += len(prev["content"])
+                    stats.bytes_dedup += st.st_size
+                    content = list(prev["content"])
+                elif st.st_size == 0:
+                    content = []
+                else:
+                    content = None  # resolved by _hash_file
+                    jobs.append((child, frel, st))
                 entries.append({**meta, "type": "file", "size": st.st_size,
-                                "content": self._backup_file(
-                                    child, f"{rel}{child.name}", st,
-                                    parent_files, stats)})
+                                "content": content, "rel": frel})
             # sockets/devices are skipped, as the data movers do
+        return {"entries": entries}
+
+    def _assemble_tree(self, skeleton: dict, contents: dict,
+                       stats: BackupStats) -> str:
+        """Deterministic bottom-up tree-blob construction from the walk
+        skeleton + hashed file contents (independent of hashing order,
+        so snapshots are bit-identical for any worker count)."""
+        entries = []
+        for e in skeleton["entries"]:
+            if e.get("skeleton") is not None:
+                sub = self._assemble_tree(e["skeleton"], contents, stats)
+                e = {k: v for k, v in e.items() if k != "skeleton"}
+                e["subtree"] = sub
+            elif e.get("type") == "file":
+                e = dict(e)
+                rel = e.pop("rel")
+                if e["content"] is None:
+                    content, size, mtime_ns = contents[rel]
+                    # Metadata observed AT read time, not walk time: a
+                    # file rewritten between the walk's lstat and the
+                    # worker's read must not pair new content with
+                    # stale size/mtime (restore's unchanged-skip
+                    # heuristic keys on them).
+                    e["content"] = content
+                    e["size"] = size
+                    e["mtime_ns"] = mtime_ns
+            entries.append(e)
         tree_json = json.dumps({"entries": entries},
                                sort_keys=True).encode()
         tid = _tree_id(tree_json)
         self.repo.add_blob(BLOB_TREE, tid, tree_json, stats)
         return tid
 
-    def _backup_file(self, path: Path, rel: str, st, parent_files: dict,
-                     stats: BackupStats) -> list[str]:
-        stats.files += 1
-        stats.bytes_scanned += st.st_size
-        prev = parent_files.get(rel)
-        if (prev is not None and prev["size"] == st.st_size
-                and prev["mtime_ns"] == st.st_mtime_ns
-                and all(self.repo.has_blob(b) for b in prev["content"])):
-            stats.blobs_dedup += len(prev["content"])
-            stats.bytes_dedup += st.st_size
-            return list(prev["content"])
-
-        content: list[str] = []
-        if st.st_size == 0:
-            return content
+    def _hash_file(self, path: Path, rel: str, st,
+                   stats: BackupStats) -> tuple[str, tuple]:
+        """Worker body: chunk+hash one file, store its blobs. Returns
+        (rel, (content, size, mtime_ns)) where size is the byte count
+        actually hashed and mtime_ns a post-read lstat — the entry must
+        describe the content that was stored, not the walk-time stat.
+        Per-blob stats are updated by the repository under its lock;
+        everything else was counted in the walk."""
         if st.st_size <= self.params.min_size:
             data = path.read_bytes()
             digest = blobid.blob_id(data)
             self.repo.add_blob(BLOB_DATA, digest, data, stats)
-            return [digest]
-        # Large files stream through the native readahead reader when
-        # available (native/volio.cpp): disk IO for segment N+1 overlaps
-        # the device hashing of segment N (plain open() fallback).
-        reader_cm = self._open_stream(path)
-        with reader_cm as reader:
-            for chunk, digest in stream_chunks(reader.read, self.params,
-                                               hasher=self.hasher):
-                self.repo.add_blob(BLOB_DATA, digest, chunk, stats)
-                content.append(digest)
-        return content
+            content = [digest]
+            hashed = len(data)
+        else:
+            # Large files stream through the native readahead reader
+            # when available (native/volio.cpp): disk IO for segment N+1
+            # overlaps the device hashing of segment N (open() fallback).
+            content = []
+            hashed = 0
+            reader_cm = self._open_stream(path)
+            with reader_cm as reader:
+                for chunk, digest in stream_chunks(reader.read, self.params,
+                                                   hasher=self.hasher):
+                    self.repo.add_blob(BLOB_DATA, digest, chunk, stats)
+                    content.append(digest)
+                    hashed += len(chunk)
+        try:
+            mtime_ns = path.lstat().st_mtime_ns
+        except OSError:  # deleted mid-backup: keep the walk-time stamp
+            mtime_ns = st.st_mtime_ns
+        return rel, (content, hashed, mtime_ns)
 
     @staticmethod
     def _open_stream(path: Path):

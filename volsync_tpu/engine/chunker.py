@@ -83,6 +83,10 @@ class DeviceChunkHasher:
     shift-invariant path.
     """
 
+    #: Safe to drive from concurrent threads: no per-call mutable state
+    #: (the fused hasher is stateless; jit caches are global/locked).
+    thread_safe = True
+
     def __init__(self, params: GearParams):
         self.params = params
         from volsync_tpu.ops.segment import LEAF_SIZE

@@ -61,6 +61,12 @@ class MeshChunkHasher:
     programs regardless of workload shape.
     """
 
+    #: NOT safe for concurrent process() calls: sharded dispatches issue
+    #: mesh collectives whose per-device enqueue order must match across
+    #: the ring, and the compiled-fn caches race. TreeBackup serializes
+    #: file hashing when this hasher is injected.
+    thread_safe = False
+
     def __init__(self, params: GearParams, mesh=None):
         import jax
 
