@@ -237,7 +237,7 @@ def test_do_not_delete_snapshot_is_relinquished(world, rng):
         assert cluster.wait_for(lambda: (
             (c := cluster.try_get("ReplicationSource", "default", "seed"))
             and c.status and c.status.last_manual_sync == "one"),
-            timeout=30, poll=0.05)
+            timeout=60, poll=0.05)
 
         rd = ReplicationDestination(
             metadata=ObjectMeta(name="rst", namespace="default"),
@@ -251,7 +251,7 @@ def test_do_not_delete_snapshot_is_relinquished(world, rng):
             (c := cluster.try_get("ReplicationDestination", "default",
                                   "rst"))
             and c.status and c.status.latest_image is not None),
-            timeout=30, poll=0.05)
+            timeout=60, poll=0.05)
         cr = cluster.get("ReplicationDestination", "default", "rst")
         protected = cr.status.latest_image.name
         snap = cluster.get("VolumeSnapshot", "default", protected)
@@ -267,13 +267,13 @@ def test_do_not_delete_snapshot_is_relinquished(world, rng):
             and c.status and c.status.last_manual_sync == "two"
             and c.status.latest_image
             and c.status.latest_image.name != protected),
-            timeout=30, poll=0.05)
+            timeout=60, poll=0.05)
 
         # The protected snapshot still exists, unowned (relinquished).
         assert cluster.wait_for(lambda: (
             (s := cluster.try_get("VolumeSnapshot", "default", protected))
             is not None
             and utils.CREATED_BY_LABEL not in s.metadata.labels
-            and not s.metadata.owner_references), timeout=30, poll=0.05)
+            and not s.metadata.owner_references), timeout=60, poll=0.05)
     finally:
         manager.stop()

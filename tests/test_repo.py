@@ -370,3 +370,44 @@ def test_parallel_backup_bit_identical_and_consistent(tmp_path, rng):
         == stats1.blobs_new + stats1.blobs_dedup
     assert stats4.bytes_scanned == stats1.bytes_scanned == 6 * 700_000 + 4
     shutil.rmtree(root4)
+
+
+def test_parallel_restore_equivalent(tmp_path, rng):
+    """Worker-pool restore must materialize the identical tree (bytes,
+    modes, mtimes incl. directory mtimes) as the serial path."""
+    import os
+
+    from volsync_tpu.engine.backup import TreeBackup
+    from volsync_tpu.engine.restore import TreeRestore
+    from volsync_tpu.objstore import FsObjectStore
+    from volsync_tpu.repo.repository import Repository
+
+    src = tmp_path / "vol"
+    (src / "deep" / "er").mkdir(parents=True)
+    (src / "a.bin").write_bytes(rng.bytes(700_000))
+    (src / "deep" / "b.bin").write_bytes(rng.bytes(5000))
+    (src / "deep" / "er" / "c.txt").write_bytes(b"leaf")
+    os.symlink("a.bin", src / "link")
+
+    repo = Repository.init(FsObjectStore(tmp_path / "repo"))
+    sid, _ = TreeBackup(repo).run(src)
+    snaps = dict(repo.list_snapshots())
+
+    def restore(workers):
+        dest = tmp_path / f"out-w{workers}"
+        TreeRestore(repo, workers=workers).run(sid, snaps[sid], dest)
+        out = {}
+        for root, _, files in os.walk(dest):
+            for f in files:
+                p = os.path.join(root, f)
+                rel = os.path.relpath(p, dest)
+                st = os.lstat(p)
+                body = None if os.path.islink(p) else open(p, "rb").read()
+                out[rel] = (body, st.st_mode, st.st_mtime_ns)
+            if root != str(dest):  # the dest root isn't snapshot metadata
+                st = os.lstat(root)
+                out[os.path.relpath(root, dest) + "/"] = (None, st.st_mode,
+                                                          st.st_mtime_ns)
+        return out
+
+    assert restore(1) == restore(4)
