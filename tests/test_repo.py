@@ -411,3 +411,28 @@ def test_parallel_restore_equivalent(tmp_path, rng):
         return out
 
     assert restore(1) == restore(4)
+
+
+def test_parallel_restore_compressible_blobs(tmp_path):
+    """Compressible content exercises the zstd path (\\x01 marker) from
+    concurrent restore workers — the shared-decompressor race this
+    guards against corrupted output nondeterministically."""
+    from volsync_tpu.engine.backup import TreeBackup
+    from volsync_tpu.engine.restore import TreeRestore
+    from volsync_tpu.objstore import FsObjectStore
+    from volsync_tpu.repo.repository import Repository
+
+    src = tmp_path / "vol"
+    src.mkdir()
+    for i in range(12):
+        # highly compressible, distinct per file
+        (src / f"t{i}.json").write_bytes(
+            (f'{{"k{i}": "v"}},' * 20_000).encode())
+    repo = Repository.init(FsObjectStore(tmp_path / "repo"))
+    sid, _ = TreeBackup(repo).run(src)
+    snaps = dict(repo.list_snapshots())
+    dest = tmp_path / "out"
+    TreeRestore(repo, workers=8).run(sid, snaps[sid], dest)
+    for i in range(12):
+        assert (dest / f"t{i}.json").read_bytes() \
+            == (src / f"t{i}.json").read_bytes()

@@ -88,7 +88,11 @@ class Repository:
         self._cur_size = 0
         self._pending_index: dict[str, list[dict]] = {}
         self._zc = zstandard.ZstdCompressor(level=3)
-        self._zd = zstandard.ZstdDecompressor()
+        # Decompression runs OUTSIDE self._lock on the concurrent
+        # restore/verify paths (read_blob from worker pools), and a
+        # ZstdDecompressor shares one ZSTD_DCtx that python-zstandard
+        # documents as not thread-safe — so it's thread-local.
+        self._zd_local = threading.local()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -303,7 +307,7 @@ class Repository:
             for key in self.store.list("index/"):
                 payload = json.loads(
                     self._zd.decompress(self.box.open(self.store.get(key)))
-                )
+                )  # under self._lock; _zd is per-thread anyway
                 for pack_id, entries in payload["packs"].items():
                     for e in entries:
                         self._index[e["id"]] = IndexEntry(
@@ -337,6 +341,13 @@ class Repository:
         if len(comp) <= len(data) * _COMPRESS_MIN_GAIN:
             return self.box.seal(b"\x01" + comp)
         return self.box.seal(b"\x00" + data)
+
+    @property
+    def _zd(self):
+        zd = getattr(self._zd_local, "zd", None)
+        if zd is None:
+            zd = self._zd_local.zd = zstandard.ZstdDecompressor()
+        return zd
 
     def _decode_blob(self, sealed: bytes) -> bytes:
         plain = self.box.open(sealed)
