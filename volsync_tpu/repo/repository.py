@@ -30,6 +30,7 @@ import zstandard
 
 from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
 from volsync_tpu.repo import blobid, crypto
+from volsync_tpu.repo.compactindex import CompactIndex
 
 BLOB_DATA = "data"
 BLOB_TREE = "tree"
@@ -76,17 +77,27 @@ class BackupStats:
 
 class Repository:
     PACK_TARGET = 16 * 1024 * 1024
+    #: Pending (not yet persisted) index entries buffered before an index
+    #: delta is written mid-run. Bounds _pending_index RAM on huge
+    #: backups: without it a 1 TiB first backup would hold ~1M entry
+    #: dicts until the final flush().
+    PENDING_INDEX_LIMIT = 32768
 
     def __init__(self, store: ObjectStore, box, config: dict):
         self.store = store
         self.box = box
         self.config = config
-        self._index: dict[str, IndexEntry] = {}
+        # Compact flat-array index (repo/compactindex.py): ~10x less RAM
+        # than dict[str, IndexEntry] at million-blob scale — the envelope
+        # is ~60 bytes/blob, so a 1 TiB repo (~1M blobs at the default
+        # ~1 MiB target) indexes in ~60 MB.
+        self._index = CompactIndex()
         self._lock = threading.RLock()
         self._cur_segments: list[bytes] = []
         self._cur_entries: list[dict] = []
         self._cur_size = 0
         self._pending_index: dict[str, list[dict]] = {}
+        self._pending_count = 0
         self._zc = zstandard.ZstdCompressor(level=3)
         # Decompression runs OUTSIDE self._lock on the concurrent
         # restore/verify paths (read_blob from worker pools), and a
@@ -304,27 +315,26 @@ class Repository:
         """
         with self._lock:
             self._index.clear()
+            # Streaming: one index delta decoded at a time; entries land
+            # in the flat compact index, never in per-entry objects.
             for key in self.store.list("index/"):
                 payload = json.loads(
                     self._zd.decompress(self.box.open(self.store.get(key)))
                 )  # under self._lock; _zd is per-thread anyway
                 for pack_id, entries in payload["packs"].items():
                     for e in entries:
-                        self._index[e["id"]] = IndexEntry(
-                            pack=pack_id, type=e["type"], offset=e["offset"],
-                            length=e["length"], raw_length=e["raw_length"],
-                        )
+                        self._index.insert(
+                            e["id"], pack_id, e["type"], e["offset"],
+                            e["length"], e["raw_length"])
             for pack_id, entries in self._pending_index.items():
                 for e in entries:
-                    self._index.setdefault(e["id"], IndexEntry(
-                        pack=pack_id, type=e["type"], offset=e["offset"],
-                        length=e["length"], raw_length=e["raw_length"],
-                    ))
+                    self._index.insert(
+                        e["id"], pack_id, e["type"], e["offset"],
+                        e["length"], e["raw_length"], replace=False)
             for e in self._cur_entries:
-                self._index.setdefault(e["id"], IndexEntry(
-                    pack="", type=e["type"], offset=e["offset"],
-                    length=e["length"], raw_length=e["raw_length"],
-                ))
+                self._index.insert(
+                    e["id"], "", e["type"], e["offset"], e["length"],
+                    e["raw_length"], replace=False)
 
     def has_blob(self, blob_id: str) -> bool:
         with self._lock:
@@ -333,6 +343,14 @@ class Repository:
     def blob_ids(self) -> set:
         with self._lock:
             return set(self._index)
+
+    def _entry(self, blob_id: str) -> Optional[IndexEntry]:
+        tup = self._index.lookup(blob_id)
+        if tup is None:
+            return None
+        pack, btype, offset, length, raw_length = tup
+        return IndexEntry(pack=pack, type=btype, offset=offset,
+                          length=length, raw_length=raw_length)
 
     # -- write path ---------------------------------------------------------
 
@@ -372,10 +390,9 @@ class Repository:
             self._cur_segments.append(seg)
             self._cur_size += len(seg)
             # visible to dedup immediately (pack id filled at flush)
-            self._index[blob_id] = IndexEntry(
-                pack="", type=btype, offset=self._cur_entries[-1]["offset"],
-                length=len(seg), raw_length=len(data),
-            )
+            self._index.insert(blob_id, "", btype,
+                               self._cur_entries[-1]["offset"], len(seg),
+                               len(data))
             if stats:
                 stats.blobs_new += 1
                 stats.bytes_new += len(data)
@@ -395,39 +412,43 @@ class Repository:
         pack_id = hashlib.sha256(blob).hexdigest()
         self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
         for e in self._cur_entries:
-            cur = self._index.get(e["id"])
-            if cur is not None and cur.pack == "":
-                cur.pack = pack_id
-            elif cur is None:
-                # a load_index between buffering and flush dropped the
-                # entry (shouldn't happen — preservation keeps buffered
-                # ids — but re-adding is always safe)
-                self._index[e["id"]] = IndexEntry(
-                    pack=pack_id, type=e["type"], offset=e["offset"],
-                    length=e["length"], raw_length=e["raw_length"])
+            cur = self._index.lookup(e["id"])
+            if cur is None or cur[0] == "":
+                # bind the buffered entry to its now-durable pack (or
+                # re-add if a load_index dropped it — always safe)
+                self._index.insert(e["id"], pack_id, e["type"], e["offset"],
+                                   e["length"], e["raw_length"])
             # else: rebound to a store-sourced pack by load_index — its
             # offset/length belong to that pack; leave it pointing there
         self._pending_index[pack_id] = self._cur_entries
+        self._pending_count += len(self._cur_entries)
         self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
+        if self._pending_count >= self.PENDING_INDEX_LIMIT:
+            self._persist_pending()
+
+    def _persist_pending(self):
+        """Write buffered index entries as one index delta object."""
+        if not self._pending_index:
+            return
+        payload = self.box.seal(self._zc.compress(json.dumps(
+            {"packs": self._pending_index}
+        ).encode()))
+        idx_id = hashlib.sha256(payload).hexdigest()
+        self.store.put(f"index/{idx_id}", payload)
+        self._pending_index = {}
+        self._pending_count = 0
 
     def flush(self):
         """Flush the open pack and persist an index delta."""
         with self._lock:
             self._flush_pack()
-            if not self._pending_index:
-                return
-            payload = self.box.seal(self._zc.compress(json.dumps(
-                {"packs": self._pending_index}
-            ).encode()))
-            idx_id = hashlib.sha256(payload).hexdigest()
-            self.store.put(f"index/{idx_id}", payload)
-            self._pending_index = {}
+            self._persist_pending()
 
     # -- read path ----------------------------------------------------------
 
     def read_blob(self, blob_id: str) -> bytes:
         with self._lock:
-            entry = self._index.get(blob_id)
+            entry = self._entry(blob_id)
             if entry is None:
                 raise RepoError(f"blob {blob_id} not in index")
             if entry.pack == "":  # still buffered in the open pack
@@ -435,6 +456,12 @@ class Repository:
                     if e["id"] == blob_id:
                         return self._decode_blob(seg)
                 raise RepoError(f"blob {blob_id} buffered but missing")
+        return self._read_packed(blob_id, entry)
+
+    def _read_packed(self, blob_id: str, entry: IndexEntry) -> bytes:
+        """Fetch + decode + verify a flushed blob WITHOUT touching
+        self._lock — safe for worker pools even while another thread
+        holds the lock (prune's rewrite readers)."""
         sealed = self.store.get_range(
             f"data/{entry.pack[:2]}/{entry.pack}", entry.offset, entry.length
         )
@@ -579,46 +606,94 @@ class Repository:
         with self.lock(exclusive=True), self._lock:
             self.flush()
             reachable = self.referenced_blobs()
-            by_pack: dict[str, list[str]] = {}
-            for blob_id, e in self._index.items():
-                by_pack.setdefault(e.pack, []).append(blob_id)
+            # Pass 1: per-pack total/live counts — no per-blob id lists,
+            # so the working set stays O(packs), not O(blobs).
+            totals: dict[str, int] = {}
+            lives: dict[str, int] = {}
+            for blob_id, (pack, *_rest) in self._index.items():
+                totals[pack] = totals.get(pack, 0) + 1
+                if blob_id in reachable:
+                    lives[pack] = lives.get(pack, 0) + 1
+            dirty = {p for p, t in totals.items()
+                     if lives.get(p, 0) < t}  # some (or all) blobs dead
             removed_blobs = 0
             rewritten = 0
-            for pack_id, blob_ids in by_pack.items():
-                live = [b for b in blob_ids if b in reachable]
-                if len(live) == len(blob_ids):
+            # Pass 2: per-dirty-pack work lists (bounded by dirty packs).
+            work: dict[str, list[str]] = {}
+            doomed: list[str] = []
+            for blob_id, (pack, *_rest) in self._index.items():
+                if pack not in dirty:
                     continue
-                for blob_id in live:  # re-add under the new pack generation
-                    data = self.read_blob(blob_id)
-                    entry = self._index.pop(blob_id)
-                    self.add_blob(entry.type, blob_id, data)
-                for blob_id in set(blob_ids) - set(live):
-                    self._index.pop(blob_id, None)
-                    removed_blobs += 1
-                rewritten += 1
+                if blob_id in reachable:
+                    work.setdefault(pack, []).append(blob_id)
+                else:
+                    doomed.append(blob_id)
+            # Rewrite one pack at a time; its live blobs are read
+            # CONCURRENTLY via the lock-free reader (store IO + decrypt
+            # overlap — the same pool pattern as check(); read_blob
+            # itself would deadlock on self._lock, which prune holds),
+            # then re-added under the new pack generation. Peak
+            # buffering is one pack's live payload.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(8) as pool:
+                for pack_id, live_ids in work.items():
+                    jobs = [(b, self._entry(b)) for b in live_ids]
+                    datas = list(pool.map(
+                        lambda j: self._read_packed(j[0], j[1]), jobs))
+                    for (blob_id, entry), data in zip(jobs, datas):
+                        self._index.remove(blob_id)
+                        self.add_blob(entry.type, blob_id, data)
+                    rewritten += 1
+                for pack_id in dirty - set(work):
+                    rewritten += 1  # fully-dead pack: nothing to rewrite
+            for blob_id in doomed:
+                self._index.remove(blob_id)
+                removed_blobs += 1
             self._flush_pack()  # step 1 durable before anything is deleted
-            # Step 2: consolidated full index.
-            full: dict[str, list[dict]] = {}
-            for blob_id, e in self._index.items():
-                full.setdefault(e.pack, []).append({
-                    "id": blob_id, "type": e.type, "offset": e.offset,
-                    "length": e.length, "raw_length": e.raw_length,
+            self._index.vacuum()
+            # Step 2: consolidated index, SHARDED into bounded delta
+            # objects (~PENDING_INDEX_LIMIT entries each) so no single
+            # index object — or its in-memory JSON — scales with the
+            # whole repository.
+            new_keys: set[str] = set()
+            shard: dict[str, list[dict]] = {}
+            count = 0
+
+            def emit_shard():
+                nonlocal shard, count
+                if not shard:
+                    return
+                payload = self.box.seal(self._zc.compress(
+                    json.dumps({"packs": shard}).encode()))
+                key = f"index/{hashlib.sha256(payload).hexdigest()}"
+                self.store.put(key, payload)
+                new_keys.add(key)
+                shard = {}
+                count = 0
+
+            for blob_id, (pack, btype, offset, length, raw) in \
+                    self._index.items():
+                shard.setdefault(pack, []).append({
+                    "id": blob_id, "type": btype, "offset": offset,
+                    "length": length, "raw_length": raw,
                 })
-            payload = self.box.seal(self._zc.compress(
-                json.dumps({"packs": full}).encode()
-            ))
-            new_index_key = f"index/{hashlib.sha256(payload).hexdigest()}"
-            self.store.put(new_index_key, payload)
+                count += 1
+                if count >= self.PENDING_INDEX_LIMIT:
+                    emit_shard()
+            emit_shard()
             # Step 3: drop superseded deltas.
             for key in list(self.store.list("index/")):
-                if key != new_index_key:
+                if key not in new_keys:
                     self.store.delete(key)
             # Step 4: sweep unreferenced pack objects.
-            live_packs = {f"data/{p[:2]}/{p}" for p in full}
+            live_packs = {f"data/{p[:2]}/{p}"
+                          for p in self._index.live_packs() if p}
             for key in list(self.store.list("data/")):
                 if key not in live_packs:
                     self.store.delete(key)
             self._pending_index = {}
+            self._pending_count = 0
             return {"packs_rewritten": rewritten,
                     "blobs_removed": removed_blobs,
                     "snapshots": len(self.list_snapshots())}
@@ -635,15 +710,19 @@ class Repository:
         read_blob and the zstd path are thread-safe)."""
         problems = []
         with self._lock:
-            entries = dict(self._index)
+            entries = self._index.copy()  # three array copies, no objects
         to_read: list[str] = []
-        for blob_id, e in entries.items():
-            key = f"data/{e.pack[:2]}/{e.pack}"
-            if not e.pack:
+        packs_seen: dict[str, bool] = {}  # pack id -> exists (memoized)
+        for blob_id, (pack, *_rest) in entries.items():
+            if not pack:
                 problems.append(f"blob {blob_id}: unflushed")
                 continue
-            if not self.store.exists(key):
-                problems.append(f"blob {blob_id}: pack {e.pack} missing")
+            ok = packs_seen.get(pack)
+            if ok is None:
+                ok = packs_seen[pack] = self.store.exists(
+                    f"data/{pack[:2]}/{pack}")
+            if not ok:
+                problems.append(f"blob {blob_id}: pack {pack} missing")
                 continue
             if read_data:
                 to_read.append(blob_id)
