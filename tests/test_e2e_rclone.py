@@ -266,3 +266,89 @@ def test_mirror_lease_blocks_concurrent_writers(tmp_path, rng):
     assert stats["files"] == 1
     # all lock objects released afterwards (own + swept stale)
     assert list(store.list(sync_mod._key("pfx", sync_mod.LOCKS))) == []
+
+
+def test_sharded_index_incremental_writes(tmp_path, rng):
+    """BASELINE configs[3] shape: many files across directories. A
+    second sync that touches ONE file must rewrite only that
+    directory's index shard (plus the manifest), not every entry."""
+    from volsync_tpu.movers.rclone import sync as sync_mod
+    from volsync_tpu.objstore import MemObjectStore
+
+    store = MemObjectStore()
+    root = tmp_path / "vol"
+    for d in range(8):
+        for f in range(4):
+            p = root / f"dir{d}" / f"f{f}.bin"
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(rng.bytes(2000))
+    s1 = sync_mod.sync_up(root, store, "p")
+    assert s1["files"] == 32
+    assert s1["index_shards_written"] == s1["index_shards"] >= 8
+
+    (root / "dir3" / "f0.bin").write_bytes(rng.bytes(2500))
+    s2 = sync_mod.sync_up(root, store, "p")
+    # one changed directory -> exactly one rewritten shard
+    assert s2["index_shards_written"] == 1
+    assert s2["uploaded"] == 1
+
+    # unchanged sync -> zero index bytes rewritten
+    s3 = sync_mod.sync_up(root, store, "p")
+    assert s3["index_shards_written"] == 0
+
+    # the merged index still restores the full tree
+    dst = tmp_path / "dst"
+    stats = sync_mod.sync_down(store, "p", dst)
+    assert stats["files"] == 32
+    for d in range(8):
+        for f in range(4):
+            rel = f"dir{d}/f{f}.bin"
+            assert (dst / rel).read_bytes() == (root / rel).read_bytes()
+
+
+def test_sharded_index_reads_legacy_v1(tmp_path, rng):
+    """Buckets written by the v1 single-object index still sync down,
+    and the next sync_up migrates them to shards and removes index.json."""
+    import json
+
+    from volsync_tpu.movers.rclone import sync as sync_mod
+    from volsync_tpu.objstore import MemObjectStore
+
+    store = MemObjectStore()
+    root = tmp_path / "vol"
+    root.mkdir()
+    payload = rng.bytes(5000)
+    (root / "a.bin").write_bytes(payload)
+    # simulate a legacy writer: objects + monolithic index.json
+    from volsync_tpu.engine.chunker import hash_file_streaming
+
+    digest = hash_file_streaming(root / "a.bin")
+    store.put("p/objects/" + digest, payload)
+    st = (root / "a.bin").lstat()
+    store.put("p/index.json", json.dumps({"version": 1, "entries": {
+        "a.bin": {"type": "file", "size": 5000, "mode": 0o644,
+                  "mtime_ns": st.st_mtime_ns, "digest": digest}}}).encode())
+
+    dst = tmp_path / "dst"
+    sync_mod.sync_down(store, "p", dst)
+    assert (dst / "a.bin").read_bytes() == payload
+
+    sync_mod.sync_up(root, store, "p")
+    assert not store.exists("p/index.json")  # migrated
+    assert store.exists("p/index/manifest.json")
+    dst2 = tmp_path / "dst2"
+    sync_mod.sync_down(store, "p", dst2)
+    assert (dst2 / "a.bin").read_bytes() == payload
+
+
+def test_sharded_index_missing_shard_is_error(tmp_path):
+    import json
+
+    from volsync_tpu.movers.rclone.sync import SyncError, read_index
+    from volsync_tpu.objstore import MemObjectStore
+
+    store = MemObjectStore()
+    store.put("p/index/manifest.json", json.dumps(
+        {"version": 2, "shards": {"ab": "ab-deadbeef.json"}}).encode())
+    with pytest.raises(SyncError, match="shard"):
+        read_index(store, "p")

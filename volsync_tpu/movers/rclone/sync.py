@@ -38,7 +38,9 @@ from volsync_tpu.objstore.store import (
     put_file,
 )
 
-INDEX_KEY = "index.json"
+INDEX_KEY = "index.json"  # legacy v1 single-object index (read-only)
+INDEX_MANIFEST = "index/manifest.json"
+INDEX_SHARDS = "index/shards"
 OBJECTS = "objects"
 DEFAULT_TRANSFERS = 10  # mover-rclone/active.sh:19
 _BATCH_BYTES = 64 * 1024 * 1024
@@ -236,7 +238,100 @@ def hash_files(root: Path, rels: list[str]) -> dict[str, str]:
     return out
 
 
+def _shard_of(rel: str) -> str:
+    """Index shard for a relpath: all entries of one DIRECTORY share a
+    shard (a changed file dirties exactly its directory's shard), hashed
+    into at most 256 buckets so huge flat trees still bound shard count."""
+    import hashlib
+
+    d = rel.rsplit("/", 1)[0] if "/" in rel else ""
+    return hashlib.sha256(d.encode()).hexdigest()[:2]
+
+
+def write_index(store: ObjectStore, prefix: str,
+                entries: dict[str, dict]) -> dict:
+    """Persist the index as per-directory shards + a small manifest.
+
+    BASELINE configs[3] (100 GiB, many small files) is metadata-heavy:
+    a monolithic index.json re-uploads every entry on every sync. Here
+    a sync touches O(changed directories) index bytes: each shard's
+    object name embeds its content hash, so unchanged shards are simply
+    re-referenced by the new manifest and never re-serialized past the
+    grouping pass. Returns {"shards": total, "written": uploaded}.
+    """
+    import hashlib
+
+    groups: dict[str, dict[str, dict]] = {}
+    for rel, e in entries.items():
+        groups.setdefault(_shard_of(rel), {})[rel] = e
+    try:
+        old_shards = json.loads(
+            store.get(_key(prefix, INDEX_MANIFEST))).get("shards", {})
+    except (NoSuchKey, ValueError):
+        old_shards = {}
+    shards: dict[str, str] = {}
+    written = 0
+    for sk in sorted(groups):
+        payload = json.dumps({"entries": groups[sk]},
+                             sort_keys=True).encode()
+        name = f"{sk}-{hashlib.sha256(payload).hexdigest()[:16]}.json"
+        shards[sk] = name
+        if old_shards.get(sk) != name:
+            store.put(_key(prefix, INDEX_SHARDS, name), payload)
+            written += 1
+    # Superseded shards are GC'd ONE GENERATION LATE: a reader holding
+    # the previous manifest must still find every shard it references
+    # (sync_down takes no lease — the v1 single-object index gave
+    # readers that atomicity for free). The manifest records the
+    # previous generation's retired names; THIS sync deletes only the
+    # generation before that.
+    retiring = sorted(set(old_shards.values()) - set(shards.values()))
+    store.put(_key(prefix, INDEX_MANIFEST), json.dumps(
+        {"version": 2, "shards": shards, "retiring": retiring},
+        sort_keys=True).encode())
+    keep = set(shards.values()) | set(retiring)
+    for key in list(store.list(_key(prefix, INDEX_SHARDS))):
+        if key.rsplit("/", 1)[-1] not in keep:
+            store.delete(key)
+    try:
+        store.delete(_key(prefix, INDEX_KEY))
+    except NoSuchKey:
+        pass
+    return {"shards": len(shards), "written": written}
+
+
 def read_index(store: ObjectStore, prefix: str) -> dict[str, dict]:
+    """Merge the sharded index (v2); fall back to the legacy single
+    index.json written by older syncs.
+
+    Readers take no lease, so a sync may supersede the manifest while
+    this runs. The one-generation-late GC keeps the just-read
+    manifest's shards alive through one concurrent sync; if a reader
+    slept through TWO syncs it restarts from the fresh manifest once
+    before declaring corruption.
+    """
+    for attempt in (0, 1):
+        try:
+            manifest = json.loads(store.get(_key(prefix, INDEX_MANIFEST)))
+        except NoSuchKey:
+            manifest = None
+        if manifest is None:
+            break
+        entries: dict[str, dict] = {}
+        try:
+            for name in manifest.get("shards", {}).values():
+                payload = json.loads(
+                    store.get(_key(prefix, INDEX_SHARDS, name)))
+                entries.update(payload.get("entries", {}))
+            return entries
+        except NoSuchKey as e:
+            if attempt:
+                # Fresh manifest and still missing a referenced shard —
+                # real corruption (or a writer violating the mirror
+                # lease), not a reason to serve a partial tree.
+                raise SyncError(
+                    f"index shard missing from bucket: {e}") from None
+            continue  # superseded mid-read: retry from the new manifest
     try:
         payload = json.loads(store.get(_key(prefix, INDEX_KEY)))
     except NoSuchKey:
@@ -282,8 +377,7 @@ def _mirror_up(root, store, prefix, entries, files, digests,
             f.result()
         uploaded = len(futs)
 
-    store.put(_key(prefix, INDEX_KEY), json.dumps(
-        {"version": 1, "entries": entries}, sort_keys=True).encode())
+    idx_stats = write_index(store, prefix, entries)
 
     # mirror: drop objects the new index no longer references
     deleted = 0
@@ -293,6 +387,8 @@ def _mirror_up(root, store, prefix, entries, files, digests,
             deleted += 1
     return {"files": len(files), "uploaded": uploaded,
             "deduped": len(files) - uploaded, "deleted_objects": deleted,
+            "index_shards": idx_stats["shards"],
+            "index_shards_written": idx_stats["written"],
             "bytes": sum(e["size"] for e in entries.values()
                          if e["type"] == "file")}
 
@@ -308,12 +404,12 @@ def sync_down(store: ObjectStore, prefix: str, root: Path, *,
     """
     root = Path(root)
     root.mkdir(parents=True, exist_ok=True)
-    try:
-        payload = json.loads(store.get(_key(prefix, INDEX_KEY)))
-    except NoSuchKey:
+    got = read_index(store, prefix)
+    if not got and not store.exists(_key(prefix, INDEX_MANIFEST)) \
+            and not store.exists(_key(prefix, INDEX_KEY)):
         raise SyncError(
             f"no index at {prefix!r}: nothing has been synced here")
-    entries = _validated_entries(payload.get("entries", {}))
+    entries = _validated_entries(got)
 
     local = scan_tree(root)
     local_files = [r for r, e in local.items() if e["type"] == "file"
