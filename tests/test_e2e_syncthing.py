@@ -236,3 +236,47 @@ def test_api_client_roundtrip(world):
         {"id": "f" * 64, "address": "tcp://127.0.0.1:1", "introducer": False}
     ]})
     assert conn.fetch().config["devices"][0]["id"] == "f" * 64
+
+
+def test_unchanged_rescan_is_stat_only(tmp_path, monkeypatch):
+    """An unchanged folder's rescan must cost stats, never re-hashing —
+    the precondition for the idle-backoff cadence being cheap."""
+    from volsync_tpu.movers.syncthing import entry as entry_mod
+
+    root = tmp_path / "data"
+    (root / "d").mkdir(parents=True)
+    (root / "d" / "f.bin").write_bytes(b"x" * 50_000)
+    idx = entry_mod.FolderIndex(tmp_path / "index.json", "dev1")
+
+    calls = []
+    real = entry_mod._hash_file
+
+    def spy(path):
+        calls.append(path)
+        return real(path)
+
+    monkeypatch.setattr(entry_mod, "_hash_file", spy)
+    assert idx.scan(root) is True
+    assert len(calls) == 1
+    for _ in range(3):
+        assert idx.scan(root) is False  # stat-gated: no hashing at all
+    assert len(calls) == 1
+    (root / "d" / "f.bin").write_bytes(b"y" * 50_001)
+    assert idx.scan(root) is True
+    assert len(calls) == 2
+
+
+def test_idle_backoff_interval_schedule():
+    from volsync_tpu.movers.syncthing.entry import _BACKOFF, _next_interval
+
+    base, ceil = 0.2, 30.0
+    iv = base
+    seen = []
+    for _ in range(40):
+        iv = _next_interval(iv, base, ceil, active=False)
+        seen.append(iv)
+    assert seen[0] == pytest.approx(base * _BACKOFF)
+    assert seen[-1] == ceil  # converges to the ceiling, never past it
+    assert all(b >= a for a, b in zip(seen, seen[1:]))
+    # any activity snaps straight back to base
+    assert _next_interval(seen[-1], base, ceil, active=True) == base

@@ -32,14 +32,32 @@ from volsync_tpu.movers.syncthing import transport
 
 log = logging.getLogger("volsync_tpu.mover.syncthing")
 
-_SCAN_INTERVAL = 0.2      # local rescan cadence (in-process substrate)
+#: Base cadences (seconds). Env-overridable for real deployments
+#: (VOLSYNC_ST_SCAN_INTERVAL / VOLSYNC_ST_SYNC_INTERVAL /
+#: VOLSYNC_ST_MAX_INTERVAL); the in-process defaults favor test
+#: latency. Idle periods BACK OFF geometrically to the max interval —
+#: an unchanged folder costs one stat-only walk per (growing) interval,
+#: never a re-read or re-hash (the scan's size+mtime gate), so a
+#: quiescent volume converges to ~zero IO the way the vendored
+#: syncthing's fs-watcher + long rescan does
+#: (mover-syncthing/entry.sh's daemon defaults to 3600s rescans).
+_SCAN_INTERVAL = 0.2      # local rescan cadence
 _SYNC_INTERVAL = 0.3      # peer reconnect/pull cadence
+_MAX_INTERVAL = 30.0      # idle-backoff ceiling for both loops
+_BACKOFF = 1.6            # growth per idle iteration
 _PULL_CHUNK = 4 * 1024 * 1024
 #: In-flight pull temp files live in the data folder (same filesystem, so
 #: the final rename is atomic) under this prefix, which the scanner and
 #: the pull verb both exclude — a crash mid-pull must never replicate a
 #: partial file.
 _TMP_PREFIX = ".volsync-st-"
+
+
+def _next_interval(cur: float, base: float, max_iv: float,
+                   active: bool) -> float:
+    """Idle-backoff step: activity snaps to base, idleness grows
+    geometrically toward the ceiling."""
+    return base if active else min(cur * _BACKOFF, max_iv)
 
 
 def _hash_file(path: Path) -> str:
@@ -383,22 +401,25 @@ class SyncthingDaemon:
                 self.index.save()
         return applied
 
-    def _sync_with(self, dev: dict):
+    def _sync_with(self, dev: dict) -> int:
+        """One pull pass against a peer; returns the number of entries
+        applied (the idle-backoff activity signal)."""
         addr = dev.get("address", "")
         if not isinstance(addr, str) or not addr.startswith("tcp://"):
-            return  # malformed/foreign address: skip, never crash the loop
+            return 0  # malformed/foreign address: skip, never crash
         host, _, port = addr[len("tcp://"):].rpartition(":")
         try:
             ch = transport.connect_device(host, int(port), self.private,
                                           dev["id"], timeout=5.0)
         except (OSError, ChannelError, ValueError):
             self.connected.pop(dev["id"], None)
-            return
+            return 0
+        applied = 0
         try:
             ch.send({"verb": "index"})
             reply = ch.recv()
             self.connected[dev["id"]] = time.time()
-            self._apply_remote(ch, reply.get("index", {}))
+            applied = self._apply_remote(ch, reply.get("index", {}))
             if dev.get("introducer"):
                 ch.send({"verb": "devices"})
                 self._adopt_introduced(dev["id"],
@@ -409,6 +430,7 @@ class SyncthingDaemon:
             pass
         finally:
             ch.close()
+        return applied
 
     def _adopt_introduced(self, introducer_id: str, devices: list):
         """Reconcile devices learned from an introducer into the live
@@ -520,19 +542,47 @@ class SyncthingDaemon:
         threading.Thread(target=self._serve,
                          args=(data_srv, self._handle_device),
                          daemon=True, name="st-data").start()
+        def knob(name: str, default: float) -> float:
+            raw = self.ctx.env.get(name, os.environ.get(name))
+            try:
+                return float(raw) if raw is not None else default
+            except ValueError:
+                log.warning("bad %s=%r, using %s", name, raw, default)
+                return default
+
+        scan_base = knob("VOLSYNC_ST_SCAN_INTERVAL", _SCAN_INTERVAL)
+        sync_base = knob("VOLSYNC_ST_SYNC_INTERVAL", _SYNC_INTERVAL)
+        max_iv = knob("VOLSYNC_ST_MAX_INTERVAL",
+                      max(_MAX_INTERVAL, scan_base, sync_base))
+        scan_iv, sync_iv = scan_base, sync_base
         last_scan = 0.0
         last_sync = 0.0
+        peers_sig: tuple = ()
         while not self.ctx.stop_event.is_set():
             now = time.monotonic()
-            if now - last_scan >= _SCAN_INTERVAL:
+            if now - last_scan >= scan_iv:
+                changed = False
                 try:
-                    self.index.scan(self.data)
+                    changed = self.index.scan(self.data)
                 except OSError as e:
                     log.warning("scan failed: %s", e)
+                # Idle backoff: an unchanged folder pays progressively
+                # rarer stat-walks; any change snaps back to base.
+                scan_iv = _next_interval(scan_iv, scan_base, max_iv, changed)
                 last_scan = now
-            if now - last_sync >= _SYNC_INTERVAL:
-                for dev in self.peer_devices():
-                    self._sync_with(dev)
+            if now - last_sync >= sync_iv:
+                peers = self.peer_devices()
+                sig = tuple(sorted(
+                    (d.get("id", ""), d.get("address", "")) for d in peers))
+                applied = sum(self._sync_with(dev) for dev in peers)
+                active = bool(applied) or sig != peers_sig
+                if active:
+                    # Remote activity (or a peer-set edit through the
+                    # control API) resets BOTH loops: fresh pulls mean
+                    # local files changed too.
+                    scan_iv = scan_base
+                    peers_sig = sig
+                sync_iv = _next_interval(sync_iv, sync_base, max_iv, active)
                 last_sync = now
             self.ctx.stop_event.wait(0.05)
         return 0
