@@ -192,3 +192,36 @@ def test_multihost_init_single_process():
     assert info["process_count"] >= 1
     assert info["global_devices"] >= info["local_devices"] >= 1
     assert init_distributed() == info  # idempotent
+
+
+def test_multihost_require_fails_hard(monkeypatch):
+    """VOLSYNC_DISTRIBUTED=1 is an explicit operator request: a failed
+    jax.distributed auto-init must abort, not silently run single-host
+    while pod peers block at the coordinator barrier (ADVICE r3)."""
+    import jax
+
+    from volsync_tpu.parallel import multihost
+
+    fn = multihost.init_distributed
+    saved = getattr(fn, "_done_args", None)
+    try:
+        if saved is not None:
+            del fn._done_args
+
+        def boom():
+            raise RuntimeError("no coordinator reachable")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        with pytest.raises(RuntimeError, match="explicitly requested"):
+            multihost.init_distributed(require=True)
+        # the implicit path still warns-and-continues — and must NOT
+        # latch, or a later require=True would get the cached
+        # single-host summary instead of the hard failure
+        info = multihost.init_distributed()
+        assert info["process_count"] >= 1
+        assert getattr(fn, "_done_args", None) is None
+        with pytest.raises(RuntimeError, match="explicitly requested"):
+            multihost.init_distributed(require=True)
+    finally:
+        if saved is not None:
+            fn._done_args = saved

@@ -237,3 +237,24 @@ def test_point_in_time_restore_selectors(world):
     assert restore("r-latest") == b"epoch-3"
     assert restore("r-prev", previous=1) == b"epoch-2"
     assert restore("r-asof", restore_as_of=t_between) == b"epoch-1"
+
+
+def test_chunker_align_knob(tmp_path):
+    """VOLSYNC_CHUNKER_ALIGN selects the CDC alignment at repo CREATION
+    (insert-heavy workloads trade the fused engine for shift-invariant
+    cuts); existing repos keep their stored chunker config."""
+    from volsync_tpu.movers.restic.entry import _open_or_init
+
+    env = {"RESTIC_REPOSITORY": f"file://{tmp_path / 'r1'}",
+           "VOLSYNC_CHUNKER_ALIGN": "64"}
+    repo = _open_or_init(env)
+    assert repo.chunker_params["align"] == 64
+    # reopen WITHOUT the knob: stored config wins
+    repo2 = _open_or_init({"RESTIC_REPOSITORY": f"file://{tmp_path / 'r1'}"})
+    assert repo2.chunker_params["align"] == 64
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="CHUNKER_ALIGN"):
+        _open_or_init({"RESTIC_REPOSITORY": f"file://{tmp_path / 'r2'}",
+                       "VOLSYNC_CHUNKER_ALIGN": "512"})

@@ -49,12 +49,31 @@ def _open_or_init(env: dict) -> Repository:
     # exactly like the reference's mover pod (restic/mover.go:317-364).
     store = open_store(env["RESTIC_REPOSITORY"], env=env)
     password = env.get("RESTIC_PASSWORD") or None
+    # Per-repo chunker-alignment knob (VOLSYNC_CHUNKER_ALIGN, set at
+    # CREATION only — existing repos keep their stored config forever).
+    # The default align=4096 runs the fused single-dispatch engine but
+    # makes cuts content-defined only modulo the 4 KiB phase: inserting
+    # a non-page-multiple length desynchronizes the rest of the file
+    # from the parent's chunks. Insert-heavy workloads can pick align=1
+    # (fully shift-invariant, classic engine) or 64 (split-phase).
+    # See docs/usage.md "Chunker alignment".
+    chunker = None
+    if env.get("VOLSYNC_CHUNKER_ALIGN"):
+        align = int(env["VOLSYNC_CHUNKER_ALIGN"])
+        if align not in (1, 64, 4096):
+            raise ValueError(
+                f"VOLSYNC_CHUNKER_ALIGN={align}: must be 1 (shift-"
+                "invariant), 64 (split-phase), or 4096 (fused page grid)")
+        from volsync_tpu.repo.repository import DEFAULT_CHUNKER
+
+        chunker = {**DEFAULT_CHUNKER, "align": align}
     try:
         repo = Repository.open(store, password=password)
     except RepoError:
         log.info("repository not initialized; creating (entry.sh:52-57)")
         try:
-            repo = Repository.init(store, password=password)
+            repo = Repository.init(store, password=password,
+                                   chunker=chunker)
         except RepoError:
             # Lost the init race to a concurrent mover sharing this
             # repository: open the winner's (init is atomic, so the

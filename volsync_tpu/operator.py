@@ -134,7 +134,43 @@ class OperatorRuntime:
 
     # lifecycle -------------------------------------------------------------
 
+    def _acquire_storage_lock(self):
+        """Single-writer guard over the storage root (the reference's
+        one-manager invariant that main.go:140-153 gets from leader
+        election and the Deployment's Recreate strategy): an exclusive
+        flock on <storage>/.volsync-manager.lock. A second manager on
+        the same root exits with a clear error instead of corrupting
+        volumes/status behind the first one's back. Ephemeral demo-mode
+        storage (fresh tempdir) needs no guard."""
+        if self._owns_storage:
+            return
+        import fcntl
+        import json as json_mod
+        import socket
+        from pathlib import Path
+
+        path = Path(self.cluster.storage.root) / ".volsync-manager.lock"
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            try:
+                holder = os.read(fd, 4096).decode(errors="replace")
+            except OSError:
+                holder = "?"
+            os.close(fd)
+            raise SystemExit(
+                f"storage path {self.cluster.storage.root} is already "
+                f"managed by another volsync-manager ({holder.strip()}); "
+                "exactly one manager may own a storage root — stop the "
+                "other instance or point VOLSYNC_STORAGE_PATH elsewhere")
+        os.ftruncate(fd, 0)
+        os.write(fd, json_mod.dumps({
+            "pid": os.getpid(), "host": socket.gethostname()}).encode())
+        self._storage_lock_fd = fd
+
     def start(self) -> "OperatorRuntime":
+        self._acquire_storage_lock()
         self.runner.start()
         self.manager.start()
         if self.metrics_server is not None:
@@ -147,6 +183,10 @@ class OperatorRuntime:
             self.metrics_server.stop()
         self.manager.stop()
         self.runner.stop()
+        fd = getattr(self, "_storage_lock_fd", None)
+        if fd is not None:
+            os.close(fd)  # releases the flock
+            self._storage_lock_fd = None
         if self._owns_storage:
             # Ephemeral demo-mode storage: don't leak volume bytes in /tmp.
             import shutil
@@ -169,7 +209,7 @@ def main(argv=None) -> int:
     if cfg["distributed"]:
         from volsync_tpu.parallel.multihost import init_distributed
 
-        info = init_distributed()
+        info = init_distributed(require=True)
         log.info("jax.distributed: process %d/%d, %d local / %d global "
                  "devices", info["process_index"], info["process_count"],
                  info["local_devices"], info["global_devices"])

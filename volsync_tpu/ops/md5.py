@@ -226,8 +226,14 @@ def md5_contiguous_blocks_device(data: jax.Array, *,
     r = data.reshape(B, block_len)
     w = pack_words_rows(r, little_endian=True)  # [B, W] LE words
 
-    if jax.default_backend() == "cpu":
-        xt = jnp.transpose(w, (1, 0))  # XLA transpose is fine on CPU
+    from volsync_tpu.ops.sha256 import use_pallas_leaves
+
+    if not use_pallas_leaves():
+        # Shares sha256's predicate (CPU backend OR the
+        # VOLSYNC_NO_PALLAS kill-switch): the operational escape hatch
+        # for a broken Mosaic toolchain must cover the MD5 delta path
+        # too, not just the leaf hashers.
+        xt = jnp.transpose(w, (1, 0))  # XLA transpose is fine here
         Bp = B
     else:
         from volsync_tpu.ops.segment import _pallas_transpose

@@ -29,7 +29,8 @@ from typing import Optional
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> dict:
+                     process_id: Optional[int] = None,
+                     require: bool = False) -> dict:
     """Initialize jax.distributed for a multi-host mesh.
 
     With no arguments, defers to JAX's TPU-pod auto-detection (the
@@ -38,6 +39,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
     ``JAX_PROCESS_ID`` env triplet. Returns a summary dict
     (process_index, process_count, local/global device counts) for the
     operator's startup log. Idempotent: calling twice is a no-op.
+
+    ``require=True`` (the operator's VOLSYNC_DISTRIBUTED=1 path) turns
+    the auto-detection warn-and-continue fallback into a hard failure:
+    when the operator EXPLICITLY asked for distributed mode, silently
+    proceeding single-host would leave the pod-slice peers that did
+    join blocked at the coordinator barrier forever.
     """
     import logging
 
@@ -74,11 +81,22 @@ def init_distributed(coordinator_address: Optional[str] = None,
         try:
             jax.distributed.initialize()
         except Exception as e:  # noqa: BLE001
+            if require:
+                raise RuntimeError(
+                    "distributed mode was explicitly requested "
+                    "(VOLSYNC_DISTRIBUTED=1) but jax.distributed "
+                    "initialization failed; refusing to run single-host "
+                    "while pod-slice peers block at the coordinator "
+                    f"barrier: {e}") from e
             log.warning(
                 "jax.distributed auto-detection unavailable (%s) — "
                 "continuing single-host; on a pod slice set "
                 "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
                 "JAX_PROCESS_ID explicitly", e)
+            # Do NOT latch: a failed soft attempt must not satisfy a
+            # later require=True call with a cached single-host summary
+            # (the hard-fail guarantee would be silently bypassed).
+            return _summary(jax)
     init_distributed._done_args = args
     return _summary(jax)
 

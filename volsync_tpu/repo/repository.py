@@ -38,6 +38,15 @@ BLOB_TREE = "tree"
 _VERIFIER_PLAINTEXT = b"volsync-tpu repository key verifier v1"
 _COMPRESS_MIN_GAIN = 0.9  # keep compressed form only if <= 90% of raw
 
+#: Default chunker parameters for new repositories — the single source
+#: of truth (Repository.init and the movers' align-override knob both
+#: build from this; see init() for the align rationale).
+DEFAULT_CHUNKER = {"min_size": 512 * 1024,
+                   "avg_size": 1024 * 1024,
+                   "max_size": 8 * 1024 * 1024,
+                   "seed": 0x5EED_CDC1,
+                   "align": 4096}
+
 
 class RepoError(RuntimeError):
     pass
@@ -131,11 +140,7 @@ class Repository:
             # the key keep align=1 (classic shift-invariant CDC), and
             # align=64 repos keep the split-phase engine, so historical
             # chunk boundaries and dedup remain valid either way.
-            "chunker": chunker or {"min_size": 512 * 1024,
-                                   "avg_size": 1024 * 1024,
-                                   "max_size": 8 * 1024 * 1024,
-                                   "seed": 0x5EED_CDC1,
-                                   "align": 4096},
+            "chunker": chunker or dict(DEFAULT_CHUNKER),
             "salt": salt.hex() if salt else None,
             "verifier": box.seal(_VERIFIER_PLAINTEXT).hex() if password else None,
         }
