@@ -1,0 +1,146 @@
+"""Cloud object-store backend routing + the Azure SharedKey client.
+
+The reference's restic mover passes the AWS/B2/Azure/GCS/Swift env
+families through to its engine (controllers/mover/restic/
+mover.go:317-364). These tests pin the rebuilt routing: a real
+SharedKey client against the verifying fake Azure server, S3-compat
+rerouting for B2/GCS, and explicit (never silent) refusals for
+missing credentials and for Swift.
+"""
+
+import pytest
+
+from volsync_tpu.objstore.azure import AzureBlobStore
+from volsync_tpu.objstore.fakeazure import FakeAzureServer
+from volsync_tpu.objstore.store import NoSuchKey, open_store
+
+
+@pytest.fixture
+def azure():
+    with FakeAzureServer() as srv:
+        store = AzureBlobStore(srv.endpoint, srv.account, srv.key_b64,
+                               "backups", "ns/repo")
+        yield srv, store
+
+
+def test_azure_roundtrip(azure):
+    _, store = azure
+    store.put("config", b"hello config")
+    assert store.get("config") == b"hello config"
+    assert store.exists("config") and not store.exists("nope")
+    assert store.size("config") == len(b"hello config")
+    assert store.get_range("config", 6, 6) == b"config"
+    with pytest.raises(NoSuchKey):
+        store.get("missing")
+    with pytest.raises(NoSuchKey):
+        store.size("missing")
+    store.delete("config")
+    assert not store.exists("config")
+    store.delete("config")  # idempotent
+
+
+def test_azure_put_if_absent(azure):
+    _, store = azure
+    assert store.put_if_absent("config", b"first") is True
+    assert store.put_if_absent("config", b"second") is False
+    assert store.get("config") == b"first"
+
+
+def test_azure_list_pagination(azure):
+    srv, store = azure
+    srv.max_results = 7
+    keys = [f"data/{i:02d}/blob{i:03d}" for i in range(25)]
+    for k in keys:
+        store.put(k, b"x")
+    assert sorted(store.list("data/")) == sorted(keys)
+    assert list(store.list("data/01/")) == ["data/01/blob001"]
+
+
+def test_azure_rejects_bad_signature(azure):
+    srv, _ = azure
+    bad = AzureBlobStore(srv.endpoint, srv.account,
+                         "d3Jvbmcta2V5", "backups")  # "wrong-key"
+    from volsync_tpu.objstore.azure import AzureError
+
+    with pytest.raises(AzureError):
+        bad.put("k", b"v")
+
+
+def test_azure_repository_end_to_end(azure, tmp_path):
+    """The restic-equivalent repository runs unmodified over Azure —
+    the same engine the reference points at azure: URLs."""
+    import numpy as np
+
+    from volsync_tpu.engine import TreeBackup, restore_snapshot
+    from volsync_tpu.repo.repository import Repository
+
+    srv, _ = azure
+    store = open_store("azure:backups:/team/repo", env={
+        "AZURE_ACCOUNT_NAME": srv.account,
+        "AZURE_ACCOUNT_KEY": srv.key_b64,
+        "AZURE_ENDPOINT": srv.endpoint,
+    })
+    repo = Repository.init(store, password="pw", chunker={
+        "min_size": 1024, "avg_size": 4096, "max_size": 16384, "seed": 7})
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.RandomState(3)
+    (src / "f.bin").write_bytes(rng.bytes(120_000))
+    snap, _ = TreeBackup(repo).run(src)
+    dst = tmp_path / "dst"
+    dst.mkdir()
+    restore_snapshot(repo, dst)
+    assert (dst / "f.bin").read_bytes() == (src / "f.bin").read_bytes()
+    assert repo.check(read_data=True) == []
+
+
+def test_azure_missing_credentials():
+    with pytest.raises(ValueError, match="AZURE_ACCOUNT_NAME"):
+        open_store("azure:c:/p", env={})
+
+
+def test_b2_routes_to_s3_compat():
+    from volsync_tpu.objstore.s3 import S3ObjectStore
+
+    st = open_store("b2:mybucket:/pfx", env={
+        "B2_ACCOUNT_ID": "id", "B2_ACCOUNT_KEY": "key",
+        "B2_REGION": "us-west-004"})
+    assert isinstance(st, S3ObjectStore)
+    assert st.bucket == "mybucket" and st.prefix == "pfx"
+    assert "backblazeb2.com" in st.host
+
+    with pytest.raises(ValueError, match="B2_ACCOUNT_ID"):
+        open_store("b2:mybucket:/pfx", env={})
+    with pytest.raises(ValueError, match="B2_S3_ENDPOINT"):
+        open_store("b2:mybucket:/pfx", env={
+            "B2_ACCOUNT_ID": "id", "B2_ACCOUNT_KEY": "key"})
+    # explicit endpoint, no region: the signing region derives from the
+    # documented hostname shape (B2 validates the credential scope)
+    st2 = open_store("b2:mybucket:/pfx", env={
+        "B2_ACCOUNT_ID": "id", "B2_ACCOUNT_KEY": "key",
+        "B2_S3_ENDPOINT": "https://s3.eu-central-003.backblazeb2.com"})
+    assert st2.region == "eu-central-003"
+    with pytest.raises(ValueError, match="B2_REGION"):
+        open_store("b2:mybucket:/pfx", env={
+            "B2_ACCOUNT_ID": "id", "B2_ACCOUNT_KEY": "key",
+            "B2_S3_ENDPOINT": "https://b2-proxy.internal"})
+
+
+def test_gs_routes_to_interop():
+    from volsync_tpu.objstore.s3 import S3ObjectStore
+
+    st = open_store("gs:bkt:/p/q", env={
+        "GS_ACCESS_KEY_ID": "a", "GS_SECRET_ACCESS_KEY": "s"})
+    assert isinstance(st, S3ObjectStore)
+    assert st.bucket == "bkt" and st.prefix == "p/q"
+    assert "storage.googleapis.com" in st.host
+
+    # service-account creds alone: explicit guidance, not misconfig
+    with pytest.raises(ValueError, match="HMAC interoperability"):
+        open_store("gs:bkt:/p", env={
+            "GOOGLE_APPLICATION_CREDENTIALS": "/sa.json"})
+
+
+def test_swift_refused_with_guidance():
+    with pytest.raises(ValueError, match="swift"):
+        open_store("swift:container:/p", env={})

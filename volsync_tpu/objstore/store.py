@@ -214,17 +214,123 @@ class MemObjectStore:
 
 
 def open_store(url: str, env: Optional[dict] = None) -> ObjectStore:
-    """Open a store by URL: ``s3:http://endpoint/bucket/prefix`` or
-    ``s3://bucket/prefix`` (restic's repository URL forms, credentials
-    from ``env`` — the Secret->env passthrough contract of
-    controllers/mover/restic/mover.go:317-364), ``file:///path``,
-    ``mem:``, or a bare path."""
+    """Open a store by repository URL with credentials from ``env`` —
+    the Secret->env passthrough contract of
+    controllers/mover/restic/mover.go:317-364.
+
+    Supported forms (restic's URL vocabulary):
+      ``s3:http://endpoint/bucket/prefix`` / ``s3://bucket/prefix``,
+      ``azure:container:/path`` (SharedKey client, objstore/azure.py),
+      ``b2:bucket:/path`` (via B2's S3-compatible endpoint),
+      ``gs:bucket:/path`` (via GCS's S3-interop XML API, HMAC keys),
+      ``file:///path``, ``mem:``, or a bare path.
+    ``swift:`` is refused with guidance (no Keystone client) rather
+    than silently misconfigured.
+    """
+    import os as _os
+
+    env_map = dict(_os.environ if env is None else env)
     if url.startswith("s3:"):
         from volsync_tpu.objstore.s3 import S3ObjectStore
 
         return S3ObjectStore.from_url(url, env=env)
+    if url.startswith("azure:"):
+        from volsync_tpu.objstore.azure import AzureBlobStore
+
+        return AzureBlobStore.from_url(url, env_map)
+    if url.startswith("b2:"):
+        return _b2_store(url, env_map)
+    if url.startswith("gs:"):
+        return _gs_store(url, env_map)
+    if url.startswith("swift:") or url.startswith("swift-temp:"):
+        raise ValueError(
+            "swift: repositories are not supported (no Keystone auth "
+            "client); point the repository at your cluster's S3 "
+            "middleware endpoint instead (s3:https://...) — see "
+            "docs/usage/restic.md")
     if url.startswith("mem:"):
         return MemObjectStore()
     if url.startswith("file://"):
         return FsObjectStore(url[len("file://"):])
     return FsObjectStore(url)
+
+
+def _bucket_path(url: str, scheme: str) -> tuple[str, str]:
+    """Split restic's ``scheme:bucket:/path`` (or ``scheme:bucket/path``)
+    into (bucket, path)."""
+    rest = url[len(scheme) + 1:]
+    if ":" in rest:
+        bucket, _, path = rest.partition(":")
+    else:
+        bucket, _, path = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"{scheme} URL {url!r} has no bucket")
+    return bucket, path.lstrip("/")
+
+
+def _b2_store(url: str, env: dict) -> ObjectStore:
+    """Backblaze B2 via its S3-compatible endpoint (restic's b2: URL,
+    B2_ACCOUNT_ID/B2_ACCOUNT_KEY env family — mover.go:331-334). B2's
+    S3 endpoint embeds the bucket's region, so it must be given:
+    B2_S3_ENDPOINT explicitly, or derived from B2_REGION."""
+    from volsync_tpu.objstore.s3 import S3ObjectStore
+
+    account = env.get("B2_ACCOUNT_ID", "")
+    key = env.get("B2_ACCOUNT_KEY", "")
+    if not account or not key:
+        raise ValueError(
+            "b2: repository needs B2_ACCOUNT_ID and B2_ACCOUNT_KEY in "
+            "the repository Secret (restic/mover.go:331-334 passthrough); "
+            "use the bucket's S3-compatible application key")
+    endpoint = env.get("B2_S3_ENDPOINT")
+    region = env.get("B2_REGION")
+    if not endpoint and region:
+        endpoint = f"https://s3.{region}.backblazeb2.com"
+    if not endpoint:
+        raise ValueError(
+            "b2: repository needs B2_S3_ENDPOINT (e.g. "
+            "https://s3.us-west-004.backblazeb2.com) or B2_REGION in "
+            "the repository Secret — B2's S3-compatible endpoint is "
+            "region-scoped")
+    if not region:
+        # B2 validates the SigV4 credential-scope region against the
+        # endpoint, so it must match — derive it from the documented
+        # hostname shape rather than defaulting to a wrong value.
+        import re as _re
+
+        m = _re.search(r"//s3\.([a-z0-9-]+)\.backblazeb2\.com", endpoint)
+        if not m:
+            raise ValueError(
+                f"cannot derive the signing region from B2_S3_ENDPOINT="
+                f"{endpoint!r}; set B2_REGION in the repository Secret")
+        region = m.group(1)
+    bucket, path = _bucket_path(url, "b2")
+    return S3ObjectStore(endpoint, bucket, path, access_key=account,
+                         secret_key=key, region=region)
+
+
+def _gs_store(url: str, env: dict) -> ObjectStore:
+    """Google Cloud Storage via the S3-interoperability XML API with
+    HMAC keys (restic's gs: URL). Service-account JSON auth
+    (GOOGLE_APPLICATION_CREDENTIALS) needs RS256 signing, which the
+    stdlib cannot do — refuse with guidance instead of misconfiguring."""
+    from volsync_tpu.objstore.s3 import S3ObjectStore
+
+    access = env.get("GS_ACCESS_KEY_ID") or env.get("AWS_ACCESS_KEY_ID", "")
+    secret = (env.get("GS_SECRET_ACCESS_KEY")
+              or env.get("AWS_SECRET_ACCESS_KEY", ""))
+    if not access or not secret:
+        hint = ""
+        if env.get("GOOGLE_APPLICATION_CREDENTIALS") or \
+                env.get("GOOGLE_PROJECT_ID"):
+            hint = (" — service-account JSON auth is not supported "
+                    "(needs RS256); create HMAC interoperability keys "
+                    "for the bucket and set GS_ACCESS_KEY_ID/"
+                    "GS_SECRET_ACCESS_KEY")
+        raise ValueError(
+            "gs: repository needs GS_ACCESS_KEY_ID and "
+            f"GS_SECRET_ACCESS_KEY in the repository Secret{hint}")
+    endpoint = env.get("GS_S3_ENDPOINT", "https://storage.googleapis.com")
+    bucket, path = _bucket_path(url, "gs")
+    return S3ObjectStore(endpoint, bucket, path, access_key=access,
+                         secret_key=secret, region="auto")
