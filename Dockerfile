@@ -1,0 +1,38 @@
+# volsync-tpu manager image — the buildable artifact behind
+# deploy/kubernetes.yaml's `image: volsync-tpu:latest` (the analogue of
+# the reference's /Dockerfile producing the controller image).
+#
+#   docker build -t volsync-tpu:latest .
+#
+# Stage 1 compiles the native IO/runtime library (native/volio.cpp) so
+# the runtime image needs no toolchain; the Python layer installs from
+# the wheel built out of this tree. JAX's TPU wheel is environment-
+# specific: bake the one matching your fleet via the JAX_EXTRA build
+# arg (defaults to CPU jax for smoke running the control plane).
+
+FROM python:3.12-slim AS build
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY . .
+RUN g++ -O2 -shared -fPIC -pthread -o /src/libvolio.so native/volio.cpp
+RUN pip install --no-cache-dir build && python -m build --wheel
+
+FROM python:3.12-slim
+ARG JAX_EXTRA="jax"
+RUN --mount=type=cache,target=/root/.cache/pip \
+    pip install ${JAX_EXTRA}
+COPY --from=build /src/dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+COPY --from=build /src/libvolio.so /opt/volsync/libvolio.so
+ENV VOLSYNC_VOLIO_SO=/opt/volsync/libvolio.so \
+    VOLSYNC_STORAGE_PATH=/var/lib/volsync \
+    VOLSYNC_METRICS_ADDR=0.0.0.0 \
+    VOLSYNC_METRICS_PORT=8080
+# Non-root (the reference's runAsNonRoot deployment contract).
+RUN useradd -r -u 10001 volsync \
+    && mkdir -p /var/lib/volsync && chown volsync /var/lib/volsync
+USER 10001
+EXPOSE 8080
+ENTRYPOINT ["volsync-manager"]

@@ -146,3 +146,63 @@ def test_engine_emits_spans(rng):
     totals = span_totals()
     assert totals.get("engine.fused_dispatch", (0,))[0] >= 1
     assert totals.get("engine.fused_fetch", (0,))[0] >= 1
+
+
+def test_manager_storage_single_writer(tmp_path):
+    """Two managers on one storage root: the second exits with a clear
+    error instead of corrupting volumes behind the first (the
+    reference's one-manager invariant — main.go:140-153 leader election
+    + the Deployment's Recreate strategy)."""
+    import pytest
+
+    from volsync_tpu.operator import OperatorRuntime
+
+    cfg = {"storage_path": str(tmp_path / "store"), "metrics_port": 0,
+           "movers": "rsync"}
+    (tmp_path / "store").mkdir()
+    first = OperatorRuntime(dict(cfg)).start()
+    try:
+        second = OperatorRuntime(dict(cfg))
+        with pytest.raises(SystemExit, match="already managed"):
+            second.start()
+        second.manager.stop()
+        second.runner.stop()
+    finally:
+        first.stop()
+    # released: a new manager may take over
+    third = OperatorRuntime(dict(cfg)).start()
+    third.stop()
+
+
+def test_prebuilt_native_so(tmp_path):
+    """The container path: VOLSYNC_VOLIO_SO points at a pre-compiled
+    library (Dockerfile builder stage) and the loader binds it without
+    a source tree or compiler."""
+    import ctypes
+    import subprocess
+    import sys
+
+    from volsync_tpu.io import native as native_mod
+
+    src = native_mod._SRC
+    if not src.is_file():
+        import pytest
+
+        pytest.skip("native source not present")
+    so = tmp_path / "libvolio.so"
+    r = subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-pthread",
+                        "-o", str(so), str(src)], capture_output=True)
+    if r.returncode != 0:
+        import pytest
+
+        pytest.skip(f"no working g++: {r.stderr[-200:]}")
+    # fresh interpreter so the module-level load cache starts cold
+    probe = (
+        "import os; os.environ['VOLSYNC_VOLIO_SO'] = %r\n"
+        "from volsync_tpu.io import native\n"
+        "assert native.available(), 'prebuilt .so did not load'\n"
+        "print('prebuilt-ok')\n" % str(so))
+    out = subprocess.run([sys.executable, "-c", probe],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "prebuilt-ok" in out.stdout

@@ -51,6 +51,19 @@ def _load() -> Optional[ctypes.CDLL]:
         _TRIED = True
         if os.environ.get("VOLSYNC_NO_NATIVE"):
             return None
+        prebuilt = os.environ.get("VOLSYNC_VOLIO_SO")
+        if prebuilt:
+            # Container images ship the library pre-compiled (Dockerfile
+            # builder stage) — no compiler in the runtime image.
+            try:
+                lib = ctypes.CDLL(prebuilt)
+                _bind(lib)  # stale/wrong .so: missing symbols degrade
+            except (OSError, AttributeError) as e:
+                log.warning("prebuilt native load failed (%s): %s",
+                            prebuilt, e)
+                return None
+            _LIB = lib
+            return _LIB
         if not _SRC.is_file():
             return None
         cache = Path(os.environ.get("VOLSYNC_NATIVE_CACHE",
@@ -68,26 +81,30 @@ def _load() -> Optional[ctypes.CDLL]:
             os.replace(tmp, so)
         try:
             lib = ctypes.CDLL(str(so))
-        except OSError as e:
+            _bind(lib)
+        except (OSError, AttributeError) as e:
             log.warning("native load failed: %s", e)
             return None
-        lib.volio_open.restype = ctypes.c_void_p
-        lib.volio_open.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-        lib.volio_next.restype = ctypes.c_int64
-        lib.volio_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.volio_close.restype = None
-        lib.volio_close.argtypes = [ctypes.c_void_p]
-        lib.volio_select_boundaries.restype = ctypes.c_int64
-        lib.volio_select_boundaries.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-        ]
         _LIB = lib
         log.info("native volio loaded from %s", so)
         return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.volio_open.restype = ctypes.c_void_p
+    lib.volio_open.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.volio_next.restype = ctypes.c_int64
+    lib.volio_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.volio_close.restype = None
+    lib.volio_close.argtypes = [ctypes.c_void_p]
+    lib.volio_select_boundaries.restype = ctypes.c_int64
+    lib.volio_select_boundaries.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
 
 
 def available() -> bool:
