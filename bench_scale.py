@@ -1,0 +1,226 @@
+"""Scale proofs for the BASELINE configs the single-chip bench can't show.
+
+Two scenarios (run manually or by CI at leisure — the driver's bench is
+bench.py; recorded output lives in docs/scale.md):
+
+  fleet  — BASELINE configs[5] scaled: N concurrent ReplicationSources
+           (default 100, the reference's MaxConcurrentReconciles) drive
+           R sync rounds through ONE manager + runner on this host.
+           Asserts every CR completes every round (zero missed
+           intervals) and reports aggregate volume throughput.
+  dedup  — BASELINE configs[4] scaled: a multi-GiB 50%-redundant
+           synthetic volume backed up through the real TreeBackup;
+           asserts the dedup ratio the redundancy implies and reports
+           the end-to-end backup rate.
+
+Each scenario prints ONE JSON line. Env knobs:
+  VOLSYNC_SCALE_CRS      fleet size           (default 100)
+  VOLSYNC_SCALE_ROUNDS   sync rounds          (default 2)
+  VOLSYNC_SCALE_MIB      per-CR volume MiB    (default 4)
+  VOLSYNC_SCALE_GIB      dedup volume GiB     (default 2)
+  VOLSYNC_SCALE_CPU      1 = skip the TPU probe, run the CPU backend
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from bench import _force_cpu_backend, _probe_backend
+
+
+def _pick_backend() -> str:
+    if os.environ.get("VOLSYNC_SCALE_CPU"):
+        _force_cpu_backend()
+        return "cpu"
+    probed = _probe_backend()
+    if probed is None or probed == "cpu":
+        _force_cpu_backend()
+        return "cpu"
+    return probed
+
+
+def scenario_fleet(n_crs: int, rounds: int, vol_mib: int) -> dict:
+    """configs[5]: N CRs, R rounds, one manager. Every CR must land
+    every round — a missed manual trigger is a missed interval."""
+    from volsync_tpu.api.common import CopyMethod, ObjectMeta
+    from volsync_tpu.api.types import (
+        ReplicationSource,
+        ReplicationSourceResticSpec,
+        ReplicationSourceSpec,
+        ReplicationTrigger,
+    )
+    from volsync_tpu.cluster.cluster import Cluster
+    from volsync_tpu.cluster.objects import Secret, Volume, VolumeSpec
+    from volsync_tpu.cluster.runner import EntrypointCatalog, JobRunner
+    from volsync_tpu.cluster.storage import StorageProvider
+    from volsync_tpu.controller.manager import Manager
+    from volsync_tpu.metrics import Metrics
+    from volsync_tpu.movers import restic as restic_mover
+    from volsync_tpu.movers.base import Catalog
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="volsync-scale-fleet-"))
+    cluster = Cluster(storage=StorageProvider(tmp / "storage"))
+    catalog = Catalog()
+    rc = EntrypointCatalog()
+    restic_mover.register(catalog, rc)
+    runner = JobRunner(cluster, rc, max_workers=16).start()
+    manager = Manager(cluster, catalog=catalog, metrics=Metrics(),
+                      workers=8).start()
+    rng = np.random.RandomState(11)
+    vol_bytes = vol_mib << 20
+    try:
+        names = []
+        for i in range(n_crs):
+            name = f"cr{i:03d}"
+            names.append(name)
+            vol = cluster.create(Volume(
+                metadata=ObjectMeta(name=f"{name}-d", namespace="default"),
+                spec=VolumeSpec(capacity=1 << 30)))
+            pathlib.Path(vol.status.path, "data.bin").write_bytes(
+                rng.bytes(vol_bytes))
+            cluster.create(Secret(
+                metadata=ObjectMeta(name=f"{name}-s", namespace="default"),
+                data={"RESTIC_REPOSITORY":
+                      str(tmp / f"repo-{name}").encode(),
+                      "RESTIC_PASSWORD": b"pw"}))
+            cluster.create(ReplicationSource(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=ReplicationSourceSpec(
+                    source_pvc=f"{name}-d",
+                    trigger=ReplicationTrigger(manual="round-0"),
+                    restic=ReplicationSourceResticSpec(
+                        repository=f"{name}-s",
+                        copy_method=CopyMethod.CLONE))))
+
+        t0 = time.perf_counter()
+        completed_rounds = 0
+        for rnd in range(rounds):
+            tag = f"round-{rnd}"
+            if rnd > 0:
+                for name in names:
+                    cr = cluster.get("ReplicationSource", "default", name)
+                    cr.spec.trigger = ReplicationTrigger(manual=tag)
+                    cluster.update(cr)
+                # each round rewrites 25% of every volume (incremental)
+                for name in names:
+                    vol = cluster.get("Volume", "default", f"{name}-d")
+                    p = pathlib.Path(vol.status.path, "data.bin")
+                    buf = bytearray(p.read_bytes())
+                    buf[: vol_bytes // 4] = rng.bytes(vol_bytes // 4)
+                    p.write_bytes(bytes(buf))
+
+            def done(tag=tag):
+                return all(
+                    (cr := cluster.try_get("ReplicationSource", "default",
+                                           n)) and cr.status
+                    and cr.status.last_manual_sync == tag
+                    for n in names)
+
+            ok = cluster.wait_for(done, timeout=1200, poll=0.25)
+            if not ok:
+                missing = [n for n in names
+                           if (cluster.get("ReplicationSource", "default",
+                                           n).status or None) is None
+                           or cluster.get("ReplicationSource", "default",
+                                          n).status.last_manual_sync != tag]
+                raise AssertionError(
+                    f"round {rnd}: {len(missing)} CRs missed the "
+                    f"interval: {missing[:5]}")
+            completed_rounds += 1
+        dt = time.perf_counter() - t0
+        total = n_crs * vol_bytes * rounds
+        return {
+            "metric": "fleet_concurrent_crs",
+            "crs": n_crs, "rounds": completed_rounds,
+            "missed_intervals": 0,
+            "volume_mib_per_cr": vol_mib,
+            "wall_s": round(dt, 1),
+            "aggregate_mib_s": round(total / dt / (1 << 20), 1),
+        }
+    finally:
+        manager.stop()
+        runner.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def scenario_dedup(total_gib: float, redundancy: float = 0.5) -> dict:
+    """configs[4]: multi-GiB 50%-redundant volume through TreeBackup;
+    the stored plaintext must reflect the redundancy."""
+    from volsync_tpu.engine import TreeBackup
+    from volsync_tpu.objstore import FsObjectStore
+    from volsync_tpu.repo.repository import Repository
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="volsync-scale-dedup-"))
+    try:
+        src = tmp / "volume"
+        src.mkdir()
+        total = int(total_gib * (1 << 30))
+        piece = 64 << 20  # written in 64 MiB files
+        rng = np.random.RandomState(23)
+        n_pieces = total // piece
+        n_unique = max(1, int(n_pieces * (1 - redundancy)))
+        uniq_payloads = []
+        for i in range(n_pieces):
+            if i < n_unique:
+                payload = rng.bytes(piece)
+                uniq_payloads.append(payload)
+            else:
+                payload = uniq_payloads[i % n_unique]  # repeated region
+            (src / f"f{i:03d}.bin").write_bytes(payload)
+
+        repo = Repository.init(FsObjectStore(tmp / "repo"))
+        t0 = time.perf_counter()
+        snap, stats = TreeBackup(repo).run(src)
+        dt = time.perf_counter() - t0
+        assert snap is not None
+        s = stats.as_dict()
+        assert s["bytes_scanned"] == total, s
+        dup_target = total - n_unique * piece
+        # Every repeated byte must dedup (identical whole files share
+        # every chunk); allow a tiny margin for the open pack.
+        assert s["bytes_dedup"] >= dup_target * 0.999, (s, dup_target)
+        ratio = s["bytes_scanned"] / max(s["bytes_new"], 1)
+        return {
+            "metric": "dedup_volume_backup",
+            "gib": round(total / (1 << 30), 2),
+            "redundancy": redundancy,
+            "dedup_ratio": round(ratio, 2),
+            "bytes_new": s["bytes_new"],
+            "bytes_dedup": s["bytes_dedup"],
+            "wall_s": round(dt, 1),
+            "mib_s": round(total / dt / (1 << 20), 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    which = (argv or sys.argv[1:]) or ["fleet", "dedup"]
+    backend = _pick_backend()
+    for scenario in which:
+        if scenario == "fleet":
+            out = scenario_fleet(
+                int(os.environ.get("VOLSYNC_SCALE_CRS", "100")),
+                int(os.environ.get("VOLSYNC_SCALE_ROUNDS", "2")),
+                int(os.environ.get("VOLSYNC_SCALE_MIB", "4")))
+        elif scenario == "dedup":
+            out = scenario_dedup(
+                float(os.environ.get("VOLSYNC_SCALE_GIB", "2")))
+        else:
+            print(f"unknown scenario {scenario!r}", file=sys.stderr)
+            return 2
+        out["backend"] = backend
+        print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
