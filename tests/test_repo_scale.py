@@ -202,3 +202,59 @@ def test_prune_writes_sharded_index(monkeypatch):
     for bid, data in ids:
         assert reopened.read_blob(bid) == data
     assert reopened.check(read_data=True) == []
+
+
+def test_prune_survives_nul_tailed_blob_ids(monkeypatch):
+    """Blob ids whose raw bytes end in 0x00 (~1/256 of all ids) must
+    survive the vectorized prune round-trip: numpy S-dtype scalar
+    extraction silently strips trailing NULs, so id extraction must go
+    through u8 rows (regression for the r4 review finding)."""
+    import hashlib as _hl
+
+    monkeypatch.setattr(Repository, "PACK_TARGET", 1 << 62)
+    store = MemObjectStore()
+    repo = Repository.init(store, chunker=SMALL_CHUNKER)
+
+    # Forge blobs until we hold ids ending in 0x00 for both a keeper
+    # and a doomed blob (content tweaked until the Merkle id obliges).
+    def find_nul_tail(seed: int):
+        i = seed
+        while True:
+            data = _incompressible(i, 600)
+            bid = blobid.blob_id(data)
+            if bid.endswith("00"):
+                return bid, data
+            i += 1
+
+    keep_id, keep_data = find_nul_tail(0)
+    doom_id, doom_data = find_nul_tail(100_000)
+    assert keep_id != doom_id
+    filler = _incompressible(7, 600)
+    fill_id = blobid.blob_id(filler)
+    for bid, data in ((keep_id, keep_data), (doom_id, doom_data),
+                      (fill_id, filler)):
+        repo.add_blob("data", bid, data)
+    repo._flush_pack()
+    repo.flush()
+
+    import json as _json
+
+    tree = {"entries": [
+        {"name": "keep", "type": "file", "mode": 0o644, "mtime_ns": 0,
+         "size": len(keep_data), "content": [keep_id]},
+        {"name": "fill", "type": "file", "mode": 0o644, "mtime_ns": 0,
+         "size": len(filler), "content": [fill_id]},
+    ]}
+    tree_json = _json.dumps(tree, sort_keys=True).encode()
+    tid = blobid.blob_id(tree_json)
+    repo.add_blob("tree", tid, tree_json)
+    repo.flush()
+    repo.save_snapshot({"hostname": "t", "paths": [], "tags": [],
+                        "tree": tid, "parent": None, "stats": {}})
+
+    assert keep_id in repo.referenced_blobs()  # hex survives extraction
+    stats = repo.prune()  # must not raise on the NUL-tailed ids
+    assert stats["blobs_removed"] == 1
+    assert repo.read_blob(keep_id) == keep_data
+    assert not repo.has_blob(doom_id)
+    assert repo.check(read_data=True) == []

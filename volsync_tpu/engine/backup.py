@@ -264,11 +264,6 @@ class TreeBackup:
 
     @staticmethod
     def _open_stream(path: Path):
-        try:
-            from volsync_tpu.io import ReadaheadReader, available
+        from volsync_tpu.engine.chunker import _open_readahead
 
-            if available():
-                return ReadaheadReader(path, 32 * 1024 * 1024)
-        except Exception:  # noqa: BLE001 — native is optional
-            pass
-        return open(path, "rb")
+        return _open_readahead(path, 32 * 1024 * 1024)

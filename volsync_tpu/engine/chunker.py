@@ -469,6 +469,20 @@ def hash_spans(buffer, spans: list[tuple[int, int]]) -> list[str]:
     return device_span_roots(_upload_padded(buffer), spans)
 
 
+def _open_readahead(path, segment_size: int):
+    """Open ``path`` through the native double-buffered readahead
+    (native/volio.cpp) when available — disk IO for segment N+1
+    overlaps the device hashing of segment N — else plain open()."""
+    try:
+        from volsync_tpu.io import ReadaheadReader, available
+
+        if available():
+            return ReadaheadReader(path, segment_size)
+    except Exception:  # noqa: BLE001 — native is optional
+        pass
+    return open(path, "rb")
+
+
 def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
     """Blob id of an arbitrarily large file with bounded memory: leaf
     digests are computed on device one ~32 MiB segment at a time and the
@@ -478,7 +492,8 @@ def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
     (segment_size % 4 KiB == 0), so the device hashes pages contiguously
     (ops/segment._page_digests_flat — no gather) and only the file's
     final partial leaf is hashed host-side from bytes already in hand.
-    One digest fetch per segment, 32 bytes per 4 KiB."""
+    One digest fetch per segment, 32 bytes per 4 KiB; reads go through
+    the native readahead so disk IO hides behind device time."""
     import hashlib
 
     from volsync_tpu.ops.segment import page_digests
@@ -486,7 +501,7 @@ def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
     assert segment_size % blobid.LEAF_SIZE == 0
     leaves: list[bytes] = []
     total = 0
-    with open(path, "rb") as f:
+    with _open_readahead(path, segment_size) as f:
         while True:
             seg = f.read(segment_size)
             if not seg:

@@ -259,6 +259,17 @@ class CompactIndex:
         self._pack_idx = {p: i for i, p in enumerate(new_packs)}
         self._rebuild_table()
 
+    def snapshot_arrays(self) -> tuple[np.ndarray, np.ndarray, list]:
+        """(keys, pack_codes, pack_names) for live entries in entry
+        order: keys is an (N,) ``S32`` array of 32-byte big-endian blob
+        ids, pack_codes indexes pack_names. The vectorized view prune
+        uses for whole-index liveness math without touching per-entry
+        Python objects."""
+        rows = np.nonzero(self._pack[: self._n] != _DEAD_PACK)[0]
+        kb = self._keys[rows].astype(">u8").tobytes()
+        keys = np.frombuffer(kb, dtype="S32")
+        return keys, self._pack[rows].copy(), list(self._packs)
+
     def live_packs(self) -> set[str]:
         """Distinct pack ids referenced by live entries — one vectorized
         pass over the pack column, no per-entry id decoding."""

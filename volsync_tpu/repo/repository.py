@@ -573,23 +573,40 @@ class Repository:
         return doomed
 
     def referenced_blobs(self) -> set:
-        """Walk all snapshot trees; returns reachable blob ids."""
-        reachable: set[str] = set()
-        stack = []
-        for _, manifest in self.list_snapshots():
-            stack.append(manifest["tree"])
+        """Walk all snapshot trees; returns reachable blob ids (hex)."""
+        import numpy as np
+
+        keys = self._referenced_keys()
+        # u8-row extraction: S-dtype scalar conversion strips trailing
+        # NUL bytes (~1/256 ids end in 0x00 and would truncate).
+        rows = keys.view(np.uint8).reshape(-1, 32)
+        return {rows[i].tobytes().hex() for i in range(rows.shape[0])}
+
+    def _referenced_keys(self):
+        """Reachable blob ids as a SORTED (N,) ``S32`` numpy array of
+        raw 32-byte ids — 32 bytes/blob instead of ~180 for a hex-string
+        set, and O(log n) vectorized membership for prune."""
+        import numpy as np
+
+        ids = bytearray()
+        seen_trees: set[str] = set()
+        stack = [m["tree"] for _, m in self.list_snapshots()]
         while stack:
             tree_id = stack.pop()
-            if tree_id in reachable:
+            if tree_id in seen_trees:
                 continue
-            reachable.add(tree_id)
+            seen_trees.add(tree_id)
+            ids += bytes.fromhex(tree_id)
             tree = json.loads(self.read_blob(tree_id))
             for entry in tree["entries"]:
                 if entry["type"] == "dir":
                     stack.append(entry["subtree"])
                 elif entry["type"] == "file":
-                    reachable.update(entry["content"])
-        return reachable
+                    for b in entry["content"]:
+                        ids += bytes.fromhex(b)
+        if not ids:
+            return np.empty((0,), dtype="S32")
+        return np.unique(np.frombuffer(bytes(ids), dtype="S32"))
 
     def prune(self) -> dict:
         """Drop unreferenced blobs by rewriting partially-live packs
@@ -608,31 +625,47 @@ class Repository:
         snapshot still restores. Takes an exclusive repository lock so a
         concurrent backup's packs/index deltas are never swept.
         """
+        import numpy as np
+
         with self.lock(exclusive=True), self._lock:
             self.flush()
-            reachable = self.referenced_blobs()
-            # Pass 1: per-pack total/live counts — no per-blob id lists,
-            # so the working set stays O(packs), not O(blobs).
-            totals: dict[str, int] = {}
-            lives: dict[str, int] = {}
-            for blob_id, (pack, *_rest) in self._index.items():
-                totals[pack] = totals.get(pack, 0) + 1
-                if blob_id in reachable:
-                    lives[pack] = lives.get(pack, 0) + 1
-            dirty = {p for p, t in totals.items()
-                     if lives.get(p, 0) < t}  # some (or all) blobs dead
+            reach = self._referenced_keys()
+            # Whole-index liveness in vectorized passes: membership via
+            # one batched searchsorted over raw 32-byte keys, per-pack
+            # totals via bincount — no per-blob Python probes, no id
+            # materialization outside the dirty packs.
+            keys, pack_codes, pack_names = self._index.snapshot_arrays()
+            if reach.size and keys.size:
+                pos = np.clip(np.searchsorted(reach, keys), 0,
+                              reach.size - 1)
+                live_mask = reach[pos] == keys
+            else:
+                live_mask = np.zeros((keys.size,), dtype=bool)
+            totals = np.bincount(pack_codes, minlength=len(pack_names))
+            lives = np.bincount(pack_codes[live_mask],
+                                minlength=len(pack_names))
+            dirty_codes = np.nonzero(lives < totals)[0]
             removed_blobs = 0
             rewritten = 0
-            # Pass 2: per-dirty-pack work lists (bounded by dirty packs).
+            # Per-dirty-pack work lists; ids decode to hex only here.
+            # Extraction goes through a u8 row view: S-dtype scalar
+            # conversion strips trailing NUL bytes, which would truncate
+            # ~1/256 blob ids and crash the rewrite.
+            keys_u8 = keys.view(np.uint8).reshape(-1, 32)
+            order = np.argsort(pack_codes, kind="stable")
+            sorted_codes = pack_codes[order]
             work: dict[str, list[str]] = {}
             doomed: list[str] = []
-            for blob_id, (pack, *_rest) in self._index.items():
-                if pack not in dirty:
-                    continue
-                if blob_id in reachable:
-                    work.setdefault(pack, []).append(blob_id)
-                else:
-                    doomed.append(blob_id)
+            for code in dirty_codes:
+                lo = np.searchsorted(sorted_codes, code, "left")
+                hi = np.searchsorted(sorted_codes, code, "right")
+                rows = order[lo:hi]
+                live_ids = [keys_u8[r].tobytes().hex() for r in rows
+                            if live_mask[r]]
+                doomed.extend(keys_u8[r].tobytes().hex() for r in rows
+                              if not live_mask[r])
+                if live_ids:
+                    work[pack_names[code]] = live_ids
             # Rewrite one pack at a time; its live blobs are read
             # CONCURRENTLY via the lock-free reader (store IO + decrypt
             # overlap — the same pool pattern as check(); read_blob
@@ -650,8 +683,8 @@ class Repository:
                         self._index.remove(blob_id)
                         self.add_blob(entry.type, blob_id, data)
                     rewritten += 1
-                for pack_id in dirty - set(work):
-                    rewritten += 1  # fully-dead pack: nothing to rewrite
+            # fully-dead packs: nothing to rewrite, still swept
+            rewritten += len(dirty_codes) - len(work)
             for blob_id in doomed:
                 self._index.remove(blob_id)
                 removed_blobs += 1
