@@ -1,0 +1,84 @@
+"""Unit tier for the driver bench's robustness machinery.
+
+bench.py is driver-critical (round 3 lost its whole perf budget to an
+unhandled backend hang), so the pieces that keep it alive get the same
+test treatment as product code: error classification, per-config
+deadlines, the synthetic-volume generator, and the host gear reference.
+"""
+
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import bench
+
+
+def test_classify_backend_errors():
+    for msg in (
+        "Unable to initialize backend 'axon': UNAVAILABLE: TPU backend",
+        "DEADLINE_EXCEEDED: something",
+        "failed to connect to all addresses",
+        "INTERNAL: stream terminated",
+    ):
+        assert bench._classify(RuntimeError(msg)) == "backend", msg
+
+
+def test_classify_oom_errors():
+    for msg in (
+        "RESOURCE_EXHAUSTED: Out of memory allocating 268435456 bytes",
+        "Attempting to allocate 2.0G",
+        "allocation of 123 failed",
+    ):
+        assert bench._classify(RuntimeError(msg)) == "oom", msg
+
+
+def test_classify_other_errors_reraise_class():
+    assert bench._classify(ValueError("shape mismatch")) == "other"
+
+
+def test_with_deadline_interrupts(monkeypatch):
+    monkeypatch.setattr(bench, "CONFIG_DEADLINE_S", 1)
+    monkeypatch.delenv("VOLSYNC_BENCH_CPU_FALLBACK", raising=False)
+    t0 = time.perf_counter()
+    with pytest.raises(bench._Deadline):
+        bench._with_deadline(time.sleep, 30)
+    assert time.perf_counter() - t0 < 5
+    # the timer is disarmed afterwards
+    assert signal.getitimer(signal.ITIMER_REAL)[0] == 0
+    # and a fast fn passes its result through
+    assert bench._with_deadline(lambda: 42) == 42
+
+
+def test_make_data_redundancy():
+    data = bench._make_data(1 << 20, redundancy=0.5)
+    assert data.shape == (1 << 20,)
+    assert data.dtype == np.uint8
+    # the two halves are distinct streams (not a trivial repeat of one)
+    assert not np.array_equal(data[: 1 << 19], data[1 << 19:])
+
+
+def test_host_gear_candidates_match_library():
+    """The bench's numpy gear reference must agree with the library's
+    scalar reference — they gate the golden check and the CPU baseline."""
+    from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS, gear_at_aligned
+
+    import jax.numpy as jnp
+
+    p = DEFAULT_PARAMS
+    host = bench._make_data(256 * 1024)
+    strict, lax_c = bench._host_gear_candidates(host, p)
+    h = np.asarray(gear_at_aligned(jnp.asarray(host), p.seed, p.align))
+    pos = np.arange(h.shape[0], dtype=np.int64) * p.align + (p.align - 1)
+    np.testing.assert_array_equal(
+        strict, pos[(h & np.uint32(p.mask_s)) == 0])
+    np.testing.assert_array_equal(
+        lax_c, pos[(h & np.uint32(p.mask_l)) == 0])
+
+
+def test_config_deadline_scales_for_cpu(monkeypatch):
+    monkeypatch.setenv("VOLSYNC_BENCH_CPU_FALLBACK", "1")
+    assert bench._config_deadline_s() == bench.CPU_CONFIG_DEADLINE_S
+    monkeypatch.delenv("VOLSYNC_BENCH_CPU_FALLBACK")
+    assert bench._config_deadline_s() == bench.CONFIG_DEADLINE_S
