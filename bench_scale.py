@@ -12,6 +12,11 @@ bench.py; recorded output lives in docs/scale.md):
            synthetic volume backed up through the real TreeBackup;
            asserts the dedup ratio the redundancy implies and reports
            the end-to-end backup rate.
+  smallfiles — BASELINE configs[3] scaled: tens of thousands of small
+           files across many directories through the rclone-style
+           mirror; measures the full sync, then a 1%-touched
+           incremental sync, asserting the incremental touches
+           O(changed) index bytes (the sharded-index economy).
 
 Each scenario prints ONE JSON line. Env knobs:
   VOLSYNC_SCALE_CRS      fleet size           (default 100)
@@ -202,8 +207,62 @@ def scenario_dedup(total_gib: float, redundancy: float = 0.5) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def scenario_smallfiles(n_files: int, n_dirs: int) -> dict:
+    """configs[3]: metadata-heavy many-small-files mirror + the
+    incremental economy of the sharded index."""
+    from volsync_tpu.movers.rclone import sync as sync_mod
+    from volsync_tpu.objstore import FsObjectStore
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="volsync-scale-small-"))
+    try:
+        src = tmp / "volume"
+        rng = np.random.RandomState(31)
+        for i in range(n_files):
+            p = src / f"d{i % n_dirs:03d}" / f"f{i:05d}.bin"
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(rng.bytes(2048 + (i % 7) * 512))
+        store = FsObjectStore(tmp / "bucket")
+
+        t0 = time.perf_counter()
+        s1 = sync_mod.sync_up(src, store, "p")
+        full_s = time.perf_counter() - t0
+        assert s1["files"] == n_files
+
+        # touch ~1% of the files, clustered in a handful of directories
+        # (churn is local in real volumes — app data dirs, not a
+        # uniform spray)
+        touched = 0
+        hot_dirs = 5
+        want = max(1, n_files // 100)
+        for i in range(n_files):
+            if touched >= want:
+                break
+            if i % n_dirs < hot_dirs:
+                p = src / f"d{i % n_dirs:03d}" / f"f{i:05d}.bin"
+                p.write_bytes(rng.bytes(3000))
+                touched += 1
+        t0 = time.perf_counter()
+        s2 = sync_mod.sync_up(src, store, "p")
+        incr_s = time.perf_counter() - t0
+        # the incremental sync re-serializes only the dirtied shards
+        assert s2["index_shards_written"] <= hot_dirs, s2
+        assert s2["index_shards_written"] < s1["index_shards"], s2
+        return {
+            "metric": "smallfiles_mirror",
+            "files": n_files, "dirs": n_dirs,
+            "full_wall_s": round(full_s, 1),
+            "full_files_per_s": round(n_files / full_s, 1),
+            "incr_wall_s": round(incr_s, 1),
+            "touched": touched,
+            "index_shards": s1["index_shards"],
+            "index_shards_written_incr": s2["index_shards_written"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
-    which = (argv or sys.argv[1:]) or ["fleet", "dedup"]
+    which = (argv or sys.argv[1:]) or ["fleet", "dedup", "smallfiles"]
     backend = _pick_backend()
     for scenario in which:
         if scenario == "fleet":
@@ -214,6 +273,10 @@ def main(argv=None) -> int:
         elif scenario == "dedup":
             out = scenario_dedup(
                 float(os.environ.get("VOLSYNC_SCALE_GIB", "2")))
+        elif scenario == "smallfiles":
+            out = scenario_smallfiles(
+                int(os.environ.get("VOLSYNC_SCALE_FILES", "20000")),
+                int(os.environ.get("VOLSYNC_SCALE_DIRS", "200")))
         else:
             print(f"unknown scenario {scenario!r}", file=sys.stderr)
             return 2
