@@ -64,16 +64,22 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 # Wall-clock budgets (seconds). The driver's historical kill is ~75 min.
 # Consistency invariant: probe worst case (sum(PROBE_TIMEOUTS)+backoffs,
-# ~330s) + the worst ladder (4 configs x CONFIG_DEADLINE_S = 1680s) +
-# the CPU baseline must fit inside GLOBAL_BUDGET_S, or the watchdog
-# would kill a still-progressing run with no JSON emitted — the exact
-# failure this file exists to prevent.
+# ~330s) + the device measurement subprocess (MEASURE_TIMEOUT_S) + the
+# CPU fallback subprocess (CPU_MEASURE_TIMEOUT_S) must fit inside
+# GLOBAL_BUDGET_S, or the watchdog would kill a still-progressing run
+# with no JSON emitted — the exact failure this file exists to prevent.
+# Each subprocess's own ladder (configs x per-config deadline) must fit
+# inside its timeout.
 PROBE_TIMEOUTS = (120, 200)
 PROBE_BACKOFF_S = 15
 CONFIG_DEADLINE_S = int(os.environ.get("VOLSYNC_BENCH_CONFIG_DEADLINE", "420"))
 CPU_CONFIG_DEADLINE_S = int(os.environ.get(
     "VOLSYNC_BENCH_CPU_CONFIG_DEADLINE", "240"))
-GLOBAL_BUDGET_S = int(os.environ.get("VOLSYNC_BENCH_BUDGET_S", "2700"))
+MEASURE_TIMEOUT_S = int(os.environ.get("VOLSYNC_BENCH_MEASURE_TIMEOUT",
+                                       "1800"))
+CPU_MEASURE_TIMEOUT_S = int(os.environ.get(
+    "VOLSYNC_BENCH_CPU_MEASURE_TIMEOUT", "1200"))
+GLOBAL_BUDGET_S = int(os.environ.get("VOLSYNC_BENCH_BUDGET_S", "3600"))
 
 _log = functools.partial(print, file=sys.stderr, flush=True)
 
@@ -361,10 +367,13 @@ def device_throughput() -> tuple[float, str]:
         # Mosaic kernels on this toolchain; the XLA scan path computes
         # identical digests by construction (golden-tested on CPU), so
         # retry once on it — a slower HONEST number beats no number,
-        # and the stderr line flags the kernel bug for follow-up.
+        # and the stderr line flags the kernel bug for follow-up. The
+        # retry runs a SHORTENED ladder (mid-size configs) so first
+        # pass + retry stay inside the measurement child's timeout.
         _log(f"bench: golden check failed with Pallas enabled ({e}); "
              f"retrying on the XLA path (VOLSYNC_NO_PALLAS=1)")
         os.environ["VOLSYNC_NO_PALLAS"] = "1"
+        os.environ.setdefault("VOLSYNC_BENCH_CONFIG", "64,8,6")
         import jax
 
         jax.clear_caches()  # cached executables still contain Pallas
@@ -397,52 +406,19 @@ def cpu_baseline(total_mib: int = 64) -> float:
     return n / dt
 
 
-def main():
+def _inner_main():
+    """Measure in THIS process. The parent decided the backend
+    (VOLSYNC_BENCH_CPU_FALLBACK selects the CPU path); any failure —
+    including a _BackendDown mid-run — simply exits nonzero and the
+    parent applies the next fallback. The inner watchdog still emits a
+    completed result if the interpreter wedges on the way out."""
     global _BEST
     threading.Thread(target=_watchdog, daemon=True).start()
-
     backend = "default"
     if os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
         _force_cpu_backend()
         backend = "cpu-fallback"
-    else:
-        probed = _probe_backend()
-        if probed is None or probed == "cpu":
-            # Dead tunnel (or the plugin silently fell through to CPU):
-            # run the CPU backend with tiny configs so the driver still
-            # records an honest, clearly-labeled number instead of
-            # rc=124 and nothing.
-            _log(f"bench: accelerator unavailable (probe={probed}) — "
-                 f"CPU-backend fallback")
-            os.environ["VOLSYNC_BENCH_CPU_FALLBACK"] = "1"
-            _force_cpu_backend()
-            backend = "cpu-fallback"
-
-    try:
-        dev, config = device_throughput()
-    except _BackendDown as e:
-        if backend == "cpu-fallback":
-            # Already the terminal fallback: a CPU-path error whose text
-            # merely pattern-matches the backend regex must fail hard,
-            # not respawn another identical child forever.
-            _log(f"bench: CPU fallback hit a backend-classified error "
-                 f"({str(e)[:200]}) — giving up")
-            raise SystemExit(71)
-        # Probe passed but the backend died mid-run: one more shot on CPU.
-        _log(f"bench: backend died mid-run ({str(e)[:200]}); CPU fallback "
-             f"in a subprocess")
-        env = dict(os.environ, VOLSYNC_BENCH_CPU_FALLBACK="1")
-        r = subprocess.run([sys.executable, __file__], timeout=1500,
-                           capture_output=True, text=True, env=env)
-        if r.returncode == 0 and r.stdout.strip():
-            line = r.stdout.strip().splitlines()[-1]
-            out = json.loads(line)
-            out["backend"] = "cpu-fallback"
-            _emit(out)
-            return 0
-        _log(f"bench: CPU fallback also failed rc={r.returncode}: "
-             f"{(r.stderr or '').strip()[-300:]}")
-        raise SystemExit(70)
+    dev, config = device_throughput()
 
     import jax
 
@@ -464,6 +440,79 @@ def main():
     with _BEST_LOCK:
         _BEST = result
     _emit(result)
+
+
+def _run_measurement_child(extra_env: dict, timeout_s: int) -> Optional[dict]:
+    """Run the measurement in a KILLABLE subprocess. SIGALRM cannot
+    interrupt a C-blocked device call (a grpc upload wedging mid-run
+    would ride out every in-process deadline), so the only hang-proof
+    boundary is a process the parent can kill."""
+    # The child's own watchdog must fire BEFORE the parent kill so a
+    # completed-but-wedged measurement still emits its result; and a
+    # result printed before a timeout kill is recovered from the
+    # exception's captured stdout.
+    env = dict(os.environ, VOLSYNC_BENCH_INNER="1",
+               VOLSYNC_BENCH_BUDGET_S=str(max(timeout_s - 60, 60)),
+               **extra_env)
+
+    def parse(stdout) -> Optional[dict]:
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        return None
+
+    try:
+        r = subprocess.run([sys.executable, __file__], timeout=timeout_s,
+                           capture_output=True, text=True, env=env)
+    except subprocess.TimeoutExpired as e:
+        out = parse(e.stdout)
+        _log(f"bench: measurement subprocess exceeded {timeout_s}s — "
+             f"killed (salvaged result: {out is not None})")
+        return out
+    tail = (r.stderr or "").strip()[-600:]
+    if tail:
+        _log(f"bench: child stderr tail:\n{tail}")
+    if r.returncode == 0 and r.stdout.strip():
+        out = parse(r.stdout)
+        if out is None:
+            _log(f"bench: child stdout unparsable: {r.stdout[-200:]!r}")
+        return out
+    _log(f"bench: measurement subprocess rc={r.returncode}")
+    return None
+
+
+def main():
+    if os.environ.get("VOLSYNC_BENCH_INNER"):
+        return _inner_main()
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    if not os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
+        probed = _probe_backend()
+        if probed is not None and probed != "cpu":
+            out = _run_measurement_child({}, MEASURE_TIMEOUT_S)
+            if out is not None:
+                _emit(out)
+                return 0
+            _log("bench: device measurement failed — CPU-backend "
+                 "fallback")
+        else:
+            _log(f"bench: accelerator unavailable (probe={probed}) — "
+                 f"CPU-backend fallback")
+
+    # Terminal fallback: CPU backend, tiny configs, clearly labeled —
+    # the driver records an honest number instead of rc=124 and nothing.
+    out = _run_measurement_child({"VOLSYNC_BENCH_CPU_FALLBACK": "1"},
+                                 CPU_MEASURE_TIMEOUT_S)
+    if out is not None:
+        out["backend"] = "cpu-fallback"
+        _emit(out)
+        return 0
+    _log("bench: every measurement path failed")
+    raise SystemExit(70)
 
 
 if __name__ == "__main__":
