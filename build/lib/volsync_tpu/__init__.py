@@ -1,0 +1,27 @@
+"""volsync-tpu: a TPU-native asynchronous volume replication & backup framework.
+
+A from-scratch rebuild of the capabilities of VolSync (reference:
+``/root/reference``, a Kubernetes operator in Go wrapping rsync / restic /
+rclone / syncthing binaries) designed TPU-first:
+
+- ``volsync_tpu.ops``      — JAX/XLA kernels for the data-plane hot loops:
+  content-defined chunking (gear rolling hash), batched SHA-256 / MD5,
+  rsync-style rolling weak checksums and delta matching.
+- ``volsync_tpu.engine``   — the data engine built on those kernels: a
+  content-addressed deduplicating repository (restic-equivalent), a
+  signature/delta/patch pipeline (rsync-equivalent), and streaming
+  host<->device pipelines.
+- ``volsync_tpu.control``  — the control plane: ReplicationSource /
+  ReplicationDestination specs & statuses, the cron/manual trigger state
+  machine, volume handling (point-in-time images), metrics, events, GC.
+- ``volsync_tpu.movers``   — the pluggable mover catalog (delta, backup,
+  bucket, live) mirroring rsync/restic/rclone/syncthing semantics.
+- ``volsync_tpu.parallel`` — device-mesh sharding of the scan pipeline
+  (data parallel across volumes x sequence parallel within a volume).
+- ``volsync_tpu.service``  — the ``mover-jax`` gRPC chunk/hash service.
+- ``volsync_tpu.cli``      — the companion CLI (replication / migration).
+"""
+
+from volsync_tpu.version import __version__
+
+__all__ = ["__version__"]

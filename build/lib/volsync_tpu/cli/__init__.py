@@ -1,0 +1,12 @@
+"""The ``volsync`` CLI (kubectl-volsync analogue, SURVEY.md §2 #22).
+
+Replication and migration verb trees over persisted relationship files;
+parse with cli.main.build_parser, dispatch with cli.main.run over named
+cluster contexts.
+"""
+
+from volsync_tpu.cli.main import build_parser, main, run
+from volsync_tpu.cli.relationship import Relationship, RelationshipError
+
+__all__ = ["build_parser", "main", "run", "Relationship",
+           "RelationshipError"]

@@ -1,0 +1,3 @@
+from volsync_tpu.cli.main import main
+
+raise SystemExit(main())

@@ -1,0 +1,23 @@
+"""Data-plane engines: device chunk+hash pipeline, backup, restore.
+
+These are what the reference's mover *containers* do (SURVEY.md §2.2),
+re-built around the TPU kernels: the CDC + SHA-256 inner loop runs on
+device (engine/chunker.py); the repository/tree logic stays host-side.
+"""
+
+from volsync_tpu.engine.backup import TreeBackup
+from volsync_tpu.engine.chunker import (
+    DeviceChunkHasher,
+    params_from_config,
+    stream_chunks,
+)
+from volsync_tpu.engine.restore import TreeRestore, restore_snapshot
+
+__all__ = [
+    "TreeBackup",
+    "TreeRestore",
+    "restore_snapshot",
+    "DeviceChunkHasher",
+    "stream_chunks",
+    "params_from_config",
+]

@@ -1,0 +1,269 @@
+"""Tree backup into a dedup repository (the `restic backup` equivalent).
+
+What `/entry.sh backup` achieves in the reference (mover-restic/
+entry.sh:58-72) — walk the volume, chunk file contents, dedup blobs by
+content hash, store packs/index, record a snapshot — with the chunk+hash
+inner loop on the TPU (engine/chunker.py) instead of inside a wrapped
+binary. Unchanged-file detection against the parent snapshot (size +
+mtime_ns, restic's heuristic) skips re-reading stable data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat as stat_mod
+from pathlib import Path
+from typing import Optional
+
+from volsync_tpu.engine.chunker import (
+    DeviceChunkHasher,
+    params_from_config,
+    stream_chunks,
+)
+from volsync_tpu.repo import blobid
+from volsync_tpu.repo.repository import (
+    BLOB_DATA,
+    BLOB_TREE,
+    BackupStats,
+    Repository,
+)
+
+
+def _tree_id(tree_json: bytes) -> str:
+    return blobid.blob_id(tree_json)
+
+
+def _load_parent_files(repo: Repository, parent_tree: str,
+                       prefix: str = "") -> dict:
+    """Flatten the parent snapshot's tree into {relpath: file entry}."""
+    out = {}
+    tree = json.loads(repo.read_blob(parent_tree))
+    for entry in tree["entries"]:
+        path = f"{prefix}{entry['name']}"
+        if entry["type"] == "file":
+            out[path] = entry
+        elif entry["type"] == "dir":
+            out.update(_load_parent_files(repo, entry["subtree"], path + "/"))
+    return out
+
+
+class TreeBackup:
+    def __init__(self, repo: Repository, *, skip_if_empty: bool = True,
+                 hasher=None, workers: Optional[int] = None):
+        """``hasher`` swaps the chunk+hash engine: single-chip
+        DeviceChunkHasher (default) or the mesh-sharded
+        parallel.sharded_chunker.MeshChunkHasher — both produce
+        bit-identical chunks/ids, so snapshots are interchangeable.
+
+        ``workers`` hashes that many FILES concurrently (default 4, env
+        VOLSYNC_BACKUP_WORKERS). Files are independent streams, so their
+        per-segment result round-trips overlap while the device
+        serializes their kernels — the same concurrency the reference
+        gets from parallel mover pods (MaxConcurrentReconciles), here
+        inside one backup. Snapshot bits are identical for any worker
+        count: tree assembly is deterministic and the repository dedups
+        concurrent identical blobs under its lock.
+        """
+        self.repo = repo
+        want = params_from_config(repo.chunker_params)
+        self.hasher = hasher or DeviceChunkHasher(want)
+        self.params = self.hasher.params
+        # An injected hasher chunking under different parameters would
+        # still produce a valid-looking snapshot — but one that shares no
+        # boundaries with prior ones, silently killing dedup. Refuse.
+        if self.params != want:
+            raise ValueError(
+                f"hasher params {self.params} != repository chunker "
+                f"params {want}")
+        self.skip_if_empty = skip_if_empty
+        if workers is None:
+            workers = int(os.environ.get("VOLSYNC_BACKUP_WORKERS", "4"))
+        # A hasher that doesn't declare thread-safety (the mesh-sharded
+        # engine: collective enqueue order must match across devices)
+        # forces serial file hashing regardless of the knob.
+        if not getattr(self.hasher, "thread_safe", False):
+            workers = 1
+        self.workers = max(1, workers)
+
+    def run(self, root, *, hostname: str = "volsync",
+            tags: Optional[list] = None,
+            parent: Optional[str] = None) -> tuple[Optional[str], BackupStats]:
+        """Backup ``root`` -> (snapshot id, stats). Returns (None, stats)
+        for an empty volume when skip_if_empty (the reference's
+        "directory is empty, skipping backup" — entry.sh:44-50).
+
+        Holds a shared repository lock so a concurrent prune (exclusive)
+        can never sweep this backup's freshly written packs.
+        """
+        with self.repo.lock(exclusive=False):
+            # Re-read the index now that the lock is held: entries loaded
+            # before it could reference packs a prune swept in between,
+            # and dedup'ing against those would produce a snapshot whose
+            # blobs no longer exist (restic reloads after locking too).
+            self.repo.load_index()
+            return self._run_locked(root, hostname=hostname, tags=tags,
+                                    parent=parent)
+
+    def _run_locked(self, root, *, hostname, tags, parent):
+        root = Path(root)
+        stats = BackupStats()
+        snaps = self.repo.list_snapshots()
+        if parent is None and snaps:
+            parent = snaps[-1][0]
+        parent_files = {}
+        parent_manifest = None
+        if parent:
+            parent_manifest = dict(snaps).get(parent)
+            if parent_manifest:
+                parent_files = _load_parent_files(
+                    self.repo, parent_manifest["tree"])
+        if self.skip_if_empty and not any(root.iterdir()):
+            return None, stats
+        # Single-threaded walk (stats + unchanged-file dedup decisions),
+        # concurrent per-file hashing, deterministic tree assembly.
+        jobs: list[tuple[Path, str, object]] = []
+        skeleton = self._walk_dir(root, "", parent_files, stats, jobs)
+        contents: dict = {}
+        if jobs:
+            if self.workers > 1 and len(jobs) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(self.workers) as pool:
+                    for rel, resolved in pool.map(
+                            lambda j: self._hash_file(*j, stats), jobs):
+                        contents[rel] = resolved
+            else:
+                for j in jobs:
+                    rel, resolved = self._hash_file(*j, stats)
+                    contents[rel] = resolved
+        tree_id = self._assemble_tree(skeleton, contents, stats)
+        manifest = {
+            "hostname": hostname,
+            "paths": [str(root)],
+            "tags": tags or [],
+            "tree": tree_id,
+            "parent": parent,
+            "stats": stats.as_dict(),
+        }
+        # Durability order matters (restic's invariant): packs and index
+        # deltas must hit the store BEFORE the snapshot that references
+        # them becomes visible, or a crash in between leaves a snapshot
+        # pointing at unwritten blobs that poisons every later backup.
+        self.repo.flush()
+        snap_id = self.repo.save_snapshot(manifest)
+        return snap_id, stats
+
+    # -- internals ----------------------------------------------------------
+
+    def _walk_dir(self, dirpath: Path, rel: str, parent_files: dict,
+                  stats: BackupStats, jobs: list) -> dict:
+        """Single-threaded walk -> a skeleton tree. File entries that
+        need hashing carry content=None and append a job; unchanged
+        files resolve to the parent's content list immediately. All
+        stats counted here (except per-blob counts, which the
+        repository updates under its own lock) so worker threads never
+        touch the shared counters."""
+        entries = []
+        for child in sorted(dirpath.iterdir(), key=lambda p: p.name):
+            st = child.lstat()
+            meta = {"name": child.name, "mode": st.st_mode & 0o7777,
+                    "mtime_ns": st.st_mtime_ns}
+            if stat_mod.S_ISLNK(st.st_mode):
+                entries.append({**meta, "type": "symlink",
+                                "target": os.readlink(child)})
+            elif stat_mod.S_ISDIR(st.st_mode):
+                sub = self._walk_dir(child, f"{rel}{child.name}/",
+                                     parent_files, stats, jobs)
+                entries.append({**meta, "type": "dir", "skeleton": sub})
+            elif stat_mod.S_ISREG(st.st_mode):
+                frel = f"{rel}{child.name}"
+                stats.files += 1
+                stats.bytes_scanned += st.st_size
+                prev = parent_files.get(frel)
+                if (prev is not None and prev["size"] == st.st_size
+                        and prev["mtime_ns"] == st.st_mtime_ns
+                        and all(self.repo.has_blob(b)
+                                for b in prev["content"])):
+                    stats.blobs_dedup += len(prev["content"])
+                    stats.bytes_dedup += st.st_size
+                    content = list(prev["content"])
+                elif st.st_size == 0:
+                    content = []
+                else:
+                    content = None  # resolved by _hash_file
+                    jobs.append((child, frel, st))
+                entries.append({**meta, "type": "file", "size": st.st_size,
+                                "content": content, "rel": frel})
+            # sockets/devices are skipped, as the data movers do
+        return {"entries": entries}
+
+    def _assemble_tree(self, skeleton: dict, contents: dict,
+                       stats: BackupStats) -> str:
+        """Deterministic bottom-up tree-blob construction from the walk
+        skeleton + hashed file contents (independent of hashing order,
+        so snapshots are bit-identical for any worker count)."""
+        entries = []
+        for e in skeleton["entries"]:
+            if e.get("skeleton") is not None:
+                sub = self._assemble_tree(e["skeleton"], contents, stats)
+                e = {k: v for k, v in e.items() if k != "skeleton"}
+                e["subtree"] = sub
+            elif e.get("type") == "file":
+                e = dict(e)
+                rel = e.pop("rel")
+                if e["content"] is None:
+                    content, size, mtime_ns = contents[rel]
+                    # Metadata observed AT read time, not walk time: a
+                    # file rewritten between the walk's lstat and the
+                    # worker's read must not pair new content with
+                    # stale size/mtime (restore's unchanged-skip
+                    # heuristic keys on them).
+                    e["content"] = content
+                    e["size"] = size
+                    e["mtime_ns"] = mtime_ns
+            entries.append(e)
+        tree_json = json.dumps({"entries": entries},
+                               sort_keys=True).encode()
+        tid = _tree_id(tree_json)
+        self.repo.add_blob(BLOB_TREE, tid, tree_json, stats)
+        return tid
+
+    def _hash_file(self, path: Path, rel: str, st,
+                   stats: BackupStats) -> tuple[str, tuple]:
+        """Worker body: chunk+hash one file, store its blobs. Returns
+        (rel, (content, size, mtime_ns)) where size is the byte count
+        actually hashed and mtime_ns a post-read lstat — the entry must
+        describe the content that was stored, not the walk-time stat.
+        Per-blob stats are updated by the repository under its lock;
+        everything else was counted in the walk."""
+        if st.st_size <= self.params.min_size:
+            data = path.read_bytes()
+            digest = blobid.blob_id(data)
+            self.repo.add_blob(BLOB_DATA, digest, data, stats)
+            content = [digest]
+            hashed = len(data)
+        else:
+            # Large files stream through the native readahead reader
+            # when available (native/volio.cpp): disk IO for segment N+1
+            # overlaps the device hashing of segment N (open() fallback).
+            content = []
+            hashed = 0
+            reader_cm = self._open_stream(path)
+            with reader_cm as reader:
+                for chunk, digest in stream_chunks(reader.read, self.params,
+                                                   hasher=self.hasher):
+                    self.repo.add_blob(BLOB_DATA, digest, chunk, stats)
+                    content.append(digest)
+                    hashed += len(chunk)
+        try:
+            mtime_ns = path.lstat().st_mtime_ns
+        except OSError:  # deleted mid-backup: keep the walk-time stamp
+            mtime_ns = st.st_mtime_ns
+        return rel, (content, hashed, mtime_ns)
+
+    @staticmethod
+    def _open_stream(path: Path):
+        from volsync_tpu.engine.chunker import _open_readahead
+
+        return _open_readahead(path, 32 * 1024 * 1024)

@@ -1,0 +1,578 @@
+"""Streaming CDC chunk+hash pipeline: the mover's device hot path.
+
+Replaces the chunk/hash core of the engine the reference wraps
+(mover-restic/entry.sh:63 `restic backup` — Rabin CDC + per-blob SHA-256
+on CPU): a segment of the input stream is uploaded to the device once,
+gear-hash CDC candidates and per-chunk SHA-256 digests both run on that
+resident buffer, and only (boundaries, digests) come back to the host.
+
+Streaming determinism: each segment handed to the CDC starts exactly at a
+chunk boundary, and no cut is eligible before min_size-1 >= 31 positions
+in, so every eligible position sees its full 32-byte gear window within
+the segment — boundaries are bit-identical to one-shot chunking of the
+whole stream (see ops/gearcdc.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from volsync_tpu.repo import blobid
+
+from volsync_tpu.ops.gearcdc import (
+    GearParams,
+    cdc_candidates,
+    cdc_candidates_aligned_packed,
+    select_boundaries,
+)
+from volsync_tpu.ops.sha256 import (
+    sha256_chunks_device,
+    sha256_leaves_device,
+)
+
+
+def params_from_config(cfg: dict) -> GearParams:
+    # Repos written before the aligned-cut format carry no "align" key;
+    # they keep the fully shift-invariant align=1 behavior forever so
+    # their existing chunk boundaries (and dedup) stay valid.
+    return GearParams(min_size=cfg["min_size"], avg_size=cfg["avg_size"],
+                      max_size=cfg["max_size"], seed=cfg["seed"],
+                      align=cfg.get("align", 1))
+
+
+def _pow2ceil(n: int, lo: int = 1) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+def _buffer_bucket(length: int) -> int:
+    """Pad target for input buffers. Shapes are static under jit, so an
+    unbounded variety of buffer lengths (every file tail is unique) would
+    mean a fresh multi-second XLA compile each — pad into a small fixed
+    set instead: pow2 up to 8 MiB, then multiples of 8 MiB."""
+    if length <= 8 * 1024 * 1024:
+        return _pow2ceil(length, 64 * 1024)
+    m = 8 * 1024 * 1024
+    return (length + m - 1) // m * m
+
+
+class DeviceChunkHasher:
+    """chunk+hash a byte buffer with one host->device upload.
+
+    All device call shapes are drawn from small bounded bucket sets
+    (padded buffer sizes, fixed candidate capacity, size-classed chunk
+    batches with pow2 lane counts) so the jit cache converges after a few
+    segments regardless of workload shape.
+
+    With the page-aligned format (align == 4096, the repo default) the
+    whole segment runs as ONE fused device program with ONE small result
+    fetch (ops/segment.py): candidates, the FastCDC walk, leaf hashing,
+    and Merkle-root assembly all stay on device, and only the chunk
+    table + 32-byte roots come back (~40 bytes per ~1 MiB chunk instead
+    of 32 bytes per 4 KiB leaf plus a candidate round-trip). The chunk
+    list is then known only at ``finish()`` — segments of ONE stream
+    serialize on that fetch, and scaling comes from concurrent streams
+    (many CRs per chip), matching the reference's concurrency model
+    (reference: controllers/replicationsource_controller.go:145).
+    64 <= align < 4096 keeps the split-phase pipeline (synchronous
+    boundary walk, leaf hashing left in flight); align=1 the legacy
+    shift-invariant path.
+    """
+
+    #: Safe to drive from concurrent threads: no per-call mutable state
+    #: (the fused hasher is stateless; jit caches are global/locked).
+    thread_safe = True
+
+    def __init__(self, params: GearParams):
+        self.params = params
+        from volsync_tpu.ops.segment import LEAF_SIZE
+
+        if params.align == LEAF_SIZE:  # the page-aligned fused format
+            from volsync_tpu.ops.segment import FusedSegmentHasher
+
+            self.fused = FusedSegmentHasher(params)
+        else:
+            self.fused = None
+
+    def process(self, buffer, *, eof: bool = True) -> list[tuple[int, int, str]]:
+        """-> [(start, length, sha256-hex)] covering ``buffer`` (the tail
+        is withheld when not ``eof`` — the caller re-feeds it)."""
+        return self.begin(buffer, eof=eof).finish()
+
+    def begin(self, buffer, *, eof: bool = True) -> "PendingSegment":
+        """Upload + dispatch the segment's device work, leaving it IN
+        FLIGHT. On the fused path the chunk table itself is part of the
+        one in-flight result, so ``.chunks``/``.end`` block until the
+        fetch; on the split-phase path (align < 4096) the boundary walk
+        runs synchronously here and only the leaf digests stay in
+        flight."""
+        import jax.numpy as jnp
+
+        if isinstance(buffer, (bytes, bytearray, memoryview)):
+            buffer = np.frombuffer(buffer, dtype=np.uint8)
+        length = int(buffer.shape[0])
+        if length == 0:
+            return PendingSegment([], None, None)
+        p = self.params
+        if length <= p.min_size:
+            if not eof:
+                return PendingSegment([], None, None)
+            return PendingSegment(
+                [(0, length, blobid.blob_id(buffer.tobytes()))], None, None)
+
+        padded = _buffer_bucket(length)
+        if padded != length:
+            buffer = np.pad(buffer, (0, padded - length))
+        return self.begin_device(jnp.asarray(buffer), length, eof=eof)
+
+    def begin_device(self, dev, length: int, *,
+                     eof: bool = True) -> "PendingSegment":
+        from volsync_tpu.obs import span
+
+        p = self.params
+        if self.fused is not None:
+            with span("engine.fused_dispatch"):
+                inflight = self.fused.dispatch(dev, length, eof=eof)
+            return PendingSegment.fused_segment(
+                self.fused, dev, length, inflight, eof)
+        with span("engine.candidates"):
+            idx_s, idx_l = self._candidates(dev, length)
+        with span("engine.boundary_walk"):
+            chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
+        if not chunks:
+            return PendingSegment([], None, None)
+        if p.align >= 64:
+            # Split-phase aligned path (64 <= align < 4096): leaf digests
+            # stay in flight; chunks are known synchronously.
+            plan = _leaf_plan(chunks)
+            dev_digests = _dispatch_leaves(
+                dev, plan[0], plan[1], plan[2],
+                leaf_fn=self.leaf_device_fn)
+            return PendingSegment.split_phase(chunks, (plan, dev_digests))
+        # Legacy unaligned path: synchronous gather hashing.
+        hexes = device_span_roots(dev, chunks, aligned=False)
+        return PendingSegment(
+            [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)],
+            None, None)
+
+    def process_device(self, dev, length: int, *,
+                       eof: bool = True) -> list[tuple[int, int, str]]:
+        """The device pipeline on an already-resident padded buffer —
+        what process() runs after upload, and what bench.py measures:
+        one fused dispatch (candidates -> on-device walk -> leaf digests
+        -> roots) plus its single result fetch."""
+        return self.begin_device(dev, length, eof=eof).finish()
+
+    def _candidates(self, dev, length: int):
+        p = self.params
+        padded = int(dev.shape[0])
+        if p.align > 1:
+            cand = self.cand_device_fn or (
+                lambda d, cap: cdc_candidates_aligned_packed(
+                    d, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+                    align=p.align, max_candidates=cap, valid_len=length))
+            cap = 4096  # expected count: padded/avg_size << 4096
+            while True:
+                packed = np.asarray(cand(dev, cap))
+                c = int(packed[-1])
+                if c <= cap:
+                    break
+                cap = _pow2ceil(c, cap * 2)
+            pos = packed[:c]
+            flags = packed[cap: cap + c].astype(bool)
+            return pos[flags], pos
+        # Classic unaligned path: one candidate per 64 bytes covers any
+        # mask down to 2^-6 density; denser (adversarial) data retries
+        # with a doubled cap.
+        cap = padded // 64
+        while True:
+            # valid_len masks the zero-padded tail on device: padding can
+            # neither add candidates nor inflate the overflow counts.
+            idx_s, count_s, idx_l, count_l = cdc_candidates(
+                dev, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+                max_candidates=cap, valid_len=length,
+            )
+            cs, cl = int(count_s), int(count_l)
+            if cs <= cap and cl <= cap:
+                break
+            cap = _pow2ceil(max(cs, cl), cap * 2)
+        return np.asarray(idx_s)[:cs], np.asarray(idx_l)[:cl]
+
+    #: Override points for the two fused device dispatches (benchmarks
+    #: compose a content-salt into the same programs; None = the library
+    #: kernels sha256_leaves_device / cdc_candidates_aligned_packed).
+    leaf_device_fn = None
+    cand_device_fn = None
+
+
+def device_leaf_digests(dev, leaf_starts: list[int],
+                        leaf_lengths: list[int]) -> list[bytes]:
+    """SHA-256 digests of arbitrary <=4 KiB slices of a device buffer,
+    every slice an independent lane (wide batch, 65-step scan, a single
+    compiled shape per lane-count bucket)."""
+    import jax.numpy as jnp
+
+    lanes = _pow2ceil(len(leaf_starts), 128)
+    starts = np.zeros((lanes,), np.int32)
+    lengths = np.zeros((lanes,), np.int32)
+    starts[: len(leaf_starts)] = leaf_starts
+    lengths[: len(leaf_lengths)] = leaf_lengths
+    digests = np.asarray(sha256_chunks_device(
+        dev, jnp.asarray(starts), jnp.asarray(lengths),
+        max_len=blobid.LEAF_SIZE,
+    )).astype(">u4")
+    leaf_bytes = digests.tobytes()  # 32 bytes per lane, row-major
+    return [leaf_bytes[32 * k : 32 * (k + 1)]
+            for k in range(len(leaf_starts))]
+
+
+def _leaf_plan(chunks: list[tuple[int, int]]):
+    """Host-side leaf assignment for a chunk list (aligned cuts): which
+    leaves are full (strided path) vs short tails (gather path), plus the
+    bookkeeping to reassemble per-chunk leaf sequences afterwards."""
+    full_rows: list[int] = []
+    short_starts: list[int] = []
+    short_lengths: list[int] = []
+    slot: list[tuple[bool, int]] = []      # leaf -> (is_full, index)
+    spans: list[tuple[int, int]] = []      # chunk -> (first leaf, count)
+    for start, length in chunks:
+        first = len(slot)
+        n = blobid.leaf_count(length)
+        for k in range(n):
+            off = k * blobid.LEAF_SIZE
+            s = start + off
+            l = min(blobid.LEAF_SIZE, length - off)
+            if l == blobid.LEAF_SIZE:
+                assert s % 64 == 0, "aligned path requires 64B leaf starts"
+                slot.append((True, len(full_rows)))
+                full_rows.append(s // 64)
+            else:
+                slot.append((False, len(short_starts)))
+                short_starts.append(s)
+                short_lengths.append(l)
+        spans.append((first, n))
+    return full_rows, short_starts, short_lengths, slot, spans
+
+
+def _dispatch_leaves(dev, full_rows, short_starts, short_lengths,
+                     leaf_fn=None):
+    """Launch the single fused leaf dispatch; returns the in-flight
+    [F + T, 8] device array (callers fetch it as late as possible)."""
+    import jax.numpy as jnp
+
+    lanes_f = _pow2ceil(len(full_rows), 128)
+    lanes_t = _pow2ceil(max(len(short_starts), 1), 8)
+    rows = np.zeros((lanes_f,), np.int32)
+    rows[: len(full_rows)] = full_rows
+    ts = np.zeros((lanes_t,), np.int32)
+    tl = np.zeros((lanes_t,), np.int32)
+    ts[: len(short_starts)] = short_starts
+    tl[: len(short_lengths)] = short_lengths
+    return (leaf_fn or sha256_leaves_device)(
+        dev, jnp.asarray(rows), jnp.asarray(ts), jnp.asarray(tl),
+        leaf_len=blobid.LEAF_SIZE), lanes_f
+
+
+def _assemble_roots(chunks, plan, digests_np, lanes_f) -> list[str]:
+    full_rows, short_starts, _, slot, spans = plan
+    flat = digests_np.astype(">u4").tobytes()
+
+    def leaf(is_full: bool, i: int) -> bytes:
+        base = (i if is_full else lanes_f + i) * 32
+        return flat[base: base + 32]
+
+    return [
+        blobid.root_from_leaves(length,
+                                [leaf(*slot[first + k]) for k in range(n)])
+        for (first, n), (_, length) in zip(spans, chunks)
+    ]
+
+
+class PendingSegment:
+    """A segment whose device work may still be in flight.
+
+    Split-phase (64 <= align < 4096) and legacy (align=1) segments know
+    their chunk list immediately; the fused path (align == 4096,
+    ops/segment.py) learns it from the one result fetch, so ``chunks``
+    / ``end`` force ``finish()`` there. Either way the public protocol
+    is: ``.end`` = bytes consumed, ``finish()`` ->
+    [(start, length, blob-id-hex)]."""
+
+    def __init__(self, done, chunks, inflight):
+        self._done = done
+        self._inflight = inflight
+        self._fused = None
+        self._chunks = (chunks if chunks is not None
+                        else [(s, l) for s, l, _ in (done or [])])
+
+    @classmethod
+    def fused_segment(cls, fsh, dev, length, inflight, eof):
+        seg = cls([], None, None)
+        seg._done = None
+        seg._chunks = None
+        seg._fused = (fsh, dev, length, inflight, eof)
+        return seg
+
+    @classmethod
+    def split_phase(cls, chunks, inflight):
+        seg = cls([], None, None)
+        seg._done = None
+        seg._chunks = list(chunks)
+        seg._inflight = inflight
+        return seg
+
+    @property
+    def chunks(self) -> list[tuple[int, int]]:
+        if self._chunks is None:
+            self.finish()
+        return self._chunks
+
+    @property
+    def end(self) -> int:
+        """One past the last covered byte (0 if nothing was emitted)."""
+        if self._fused is not None and self._done is None:
+            self.finish()
+            return self._consumed
+        if not self.chunks:
+            return 0
+        s, l = self.chunks[-1][0], self.chunks[-1][1]
+        return int(s) + int(l)
+
+    def finish(self) -> list[tuple[int, int, str]]:
+        if self._done is not None:
+            return self._done
+        from volsync_tpu.obs import span
+
+        if self._fused is not None:
+            fsh, dev, length, inflight, eof = self._fused
+            with span("engine.fused_fetch"):
+                chunks, consumed = fsh.finish(dev, length, inflight, eof=eof)
+            self._done = chunks
+            self._chunks = [(s, l) for s, l, _ in chunks]
+            self._consumed = consumed
+            return self._done
+        (plan, (dev_digests, lanes_f)) = self._inflight
+        with span("engine.leaf_fetch_assemble"):
+            hexes = _assemble_roots(self._chunks, plan,
+                                    np.asarray(dev_digests), lanes_f)
+        self._done = [(int(s), int(l), h)
+                      for (s, l), h in zip(self._chunks, hexes)]
+        self._inflight = None
+        return self._done
+
+
+def device_span_roots(dev, chunks: list[tuple[int, int]], *,
+                      aligned: bool = False, leaf_fn=None) -> list[str]:
+    """Merkle blob ids for (start, length) slices of the device buffer
+    (repo/blobid.py): every 4 KiB leaf of every chunk hashes as one
+    independent lane, then the tiny roots combine host-side.
+
+    ``aligned=True`` asserts every chunk start is 64-byte aligned
+    (GearParams.align >= 64): full leaves then take the strided
+    row-gather path and only each chunk's short tail leaf (<4 KiB)
+    pays the generic gather kernel, in ONE fused dispatch.
+    """
+    if aligned:
+        plan = _leaf_plan(chunks)
+        dev_digests, lanes_f = _dispatch_leaves(
+            dev, plan[0], plan[1], plan[2], leaf_fn=leaf_fn)
+        return _assemble_roots(chunks, plan, np.asarray(dev_digests),
+                               lanes_f)
+    leaf_starts: list[int] = []
+    leaf_lengths: list[int] = []
+    spans: list[tuple[int, int]] = []  # (first leaf index, count) per chunk
+    for start, length in chunks:
+        first = len(leaf_starts)
+        n = blobid.leaf_count(length)
+        for k in range(n):
+            off = k * blobid.LEAF_SIZE
+            leaf_starts.append(start + off)
+            leaf_lengths.append(min(blobid.LEAF_SIZE, length - off))
+        spans.append((first, n))
+    leaves = device_leaf_digests(dev, leaf_starts, leaf_lengths)
+    return [
+        blobid.root_from_leaves(length, leaves[first : first + n])
+        for (first, n), (_, length) in zip(spans, chunks)
+    ]
+
+
+def _upload_padded(buffer):
+    """Host bytes/array -> device array padded to a bucketed length."""
+    import jax.numpy as jnp
+
+    if isinstance(buffer, (bytes, bytearray, memoryview)):
+        buffer = np.frombuffer(buffer, dtype=np.uint8)
+    length = int(buffer.shape[0])
+    padded = _buffer_bucket(max(length, 1))
+    if padded != length:
+        buffer = np.pad(buffer, (0, padded - length))
+    return jnp.asarray(buffer)
+
+
+def _spans_page_disjoint(spans: list[tuple[int, int]]) -> bool:
+    """True iff every span starts on the 4 KiB page grid and no two
+    spans touch the same page — the precondition for the shared
+    page-digest table in ops/segment.span_roots_device (its per-span
+    tail override mutates that table in place). Zero-length spans touch
+    no pages (they're hashed host-side)."""
+    last_page = -1
+    for s, l in sorted(spans):
+        if s % blobid.LEAF_SIZE != 0:
+            return False
+        if l <= 0:
+            continue
+        if s // blobid.LEAF_SIZE <= last_page:
+            return False
+        last_page = (s + l - 1) // blobid.LEAF_SIZE
+    return True
+
+
+def hash_spans(buffer, spans: list[tuple[int, int]]) -> list[str]:
+    """Device-batched blob ids for (start, length) spans of one buffer.
+
+    The checksum-compare primitive for the rclone-style mover (the
+    reference's `rclone sync --checksum`, mover-rclone/active.sh:19).
+    When every span start is 4 KiB-aligned (the mover's packer pads to
+    the page grid), this is ONE fused dispatch + ONE [N, 8] fetch:
+    all full leaves are pages of the buffer (contiguous hashing, no
+    gather) and only each span's short tail pays the gather path
+    (ops/segment.span_roots_device). Unaligned spans fall back to the
+    generic per-leaf gather batch.
+    """
+    if not spans:
+        return []
+    if _spans_page_disjoint(spans):
+        import jax.numpy as jnp
+
+        from volsync_tpu.ops.segment import span_roots_device
+
+        n_cap = _pow2ceil(len(spans), 128)
+        starts = np.full((n_cap,), 0, np.int32)
+        lengths = np.full((n_cap,), -1, np.int32)  # padding lanes
+        starts[: len(spans)] = [s for s, _ in spans]
+        lengths[: len(spans)] = [l for _, l in spans]
+        # Zero-length spans consume no pages, so their device tail
+        # override would collide with whatever span owns that page —
+        # their id is a constant anyway.
+        empty = lengths[: len(spans)] == 0
+        lengths[: len(spans)][empty] = -1
+        roots = np.asarray(span_roots_device(
+            _upload_padded(buffer), jnp.asarray(starts),
+            jnp.asarray(lengths))).astype(">u4")
+        empty_id = blobid.blob_id(b"")
+        return [empty_id if empty[i] else roots[i].tobytes().hex()
+                for i in range(len(spans))]
+    return device_span_roots(_upload_padded(buffer), spans)
+
+
+def _open_readahead(path, segment_size: int):
+    """Open ``path`` through the native double-buffered readahead
+    (native/volio.cpp) when available — disk IO for segment N+1
+    overlaps the device hashing of segment N — else plain open()."""
+    try:
+        from volsync_tpu.io import ReadaheadReader, available
+
+        if available():
+            return ReadaheadReader(path, segment_size)
+    except Exception:  # noqa: BLE001 — native is optional
+        pass
+    return open(path, "rb")
+
+
+def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
+    """Blob id of an arbitrarily large file with bounded memory: leaf
+    digests are computed on device one ~32 MiB segment at a time and the
+    root combines host-side (repo/blobid.py).
+
+    Every leaf of a whole-file stream is a PAGE of its segment
+    (segment_size % 4 KiB == 0), so the device hashes pages contiguously
+    (ops/segment._page_digests_flat — no gather) and only the file's
+    final partial leaf is hashed host-side from bytes already in hand.
+    One digest fetch per segment, 32 bytes per 4 KiB; reads go through
+    the native readahead so disk IO hides behind device time."""
+    import hashlib
+
+    from volsync_tpu.ops.segment import page_digests
+
+    assert segment_size % blobid.LEAF_SIZE == 0
+    leaves: list[bytes] = []
+    total = 0
+    with _open_readahead(path, segment_size) as f:
+        while True:
+            seg = f.read(segment_size)
+            if not seg:
+                break
+            total += len(seg)
+            full = len(seg) // blobid.LEAF_SIZE
+            if full:
+                dev = _upload_padded(seg[: full * blobid.LEAF_SIZE])
+                dig = page_digests(dev)[:full].astype(">u4")
+                leaves.extend(dig[k].tobytes() for k in range(full))
+            tail = seg[full * blobid.LEAF_SIZE:]
+            if tail:
+                leaves.append(hashlib.sha256(tail).digest())
+    if total == 0:
+        return blobid.blob_id(b"")
+    return blobid.root_from_leaves(total, leaves)
+
+
+def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
+                  segment_size: int = 32 * 1024 * 1024,
+                  hasher: Optional[DeviceChunkHasher] = None,
+                  ) -> Iterator[tuple[bytes, str]]:
+    """Chunk an arbitrary-length stream -> (chunk bytes, sha256 hex).
+
+    ``reader(n)`` returns up to n bytes, b"" at EOF. Segments are chunked
+    on device; the unterminated tail of each segment is carried into the
+    next so boundaries match one-shot chunking.
+
+    On the fused path (align == 4096, the repo default) each segment is
+    one device dispatch and one small result fetch; the buffer can only
+    advance once that fetch lands, so segments of one stream serialize
+    on a single round-trip each (sub-ms on a TPU VM). Aggregate
+    throughput scales across concurrent streams — one per
+    ReplicationSource, mirroring the reference's
+    MaxConcurrentReconciles=100 concurrency model — and with the
+    segment size. 64 <= align < 4096 keeps the split-phase pipeline
+    (synchronous boundary walk, leaf digests in flight across loop
+    iterations); align=1 the legacy synchronous path.
+    """
+    hasher = hasher or DeviceChunkHasher(params)
+    pending = b""
+    eof = False
+    prev: Optional[tuple[bytes, object]] = None  # (segment bytes, pending token)
+    while True:
+        while not eof and len(pending) < segment_size + params.max_size:
+            piece = reader(segment_size)
+            if not piece:
+                eof = True
+            else:
+                pending += piece
+        begin = getattr(hasher, "begin", None)
+        if begin is not None:
+            token = begin(np.frombuffer(pending, np.uint8), eof=eof)
+        else:
+            # Engines without split-phase support (e.g. the mesh hasher)
+            # still work, just without the overlap.
+            token = PendingSegment(hasher.process(
+                np.frombuffer(pending, np.uint8), eof=eof), None, None)
+        consumed = token.end
+        if prev is not None:
+            seg_bytes, prev_token = prev
+            for start, length, digest in prev_token.finish():
+                yield seg_bytes[start: start + length], digest
+        prev = (pending, token)
+        pending = pending[consumed:]
+        if eof:
+            seg_bytes, last = prev
+            for start, length, digest in last.finish():
+                yield seg_bytes[start: start + length], digest
+            return
+        # A non-eof pass over more than max_size bytes always emits at
+        # least one chunk (max_size forces a cut), so progress is
+        # guaranteed; assert to fail loudly rather than loop forever.
+        assert consumed > 0, "chunker made no progress"

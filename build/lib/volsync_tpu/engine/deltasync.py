@@ -1,0 +1,247 @@
+"""rsync-style delta synchronization engine (device-accelerated).
+
+The algorithm of the reference's `rsync -aAhHSxz --delete` hot loop
+(mover-rsync/source.sh:54), re-expressed on TPU primitives
+(ops/rolling.py, ops/delta.py, ops/md5.py):
+
+  destination:  per-block signature = (weak32, MD5) per block_len block
+  source:       rolling weak checksum at EVERY offset in one parallel
+                pass -> membership vs the signature's sorted weak set ->
+                batched MD5 verification of candidate windows -> greedy
+                left-to-right op selection on host (sparse matches only)
+  ops stream:   COPY(block_index, n_blocks) | DATA(bytes), applied on the
+                destination against its current file
+
+Block size follows rsync's heuristic (~sqrt(file size), bounded), bucket-
+rounded so device call shapes stay bounded (see engine/chunker.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Iterator, Optional
+
+import numpy as np
+
+from volsync_tpu.ops.delta import build_signature, match_offsets, verify_candidates
+from volsync_tpu.ops.rolling import weak_checksum_host
+
+MIN_BLOCK = 4096
+MAX_BLOCK = 128 * 1024
+
+
+def pick_block_len(size: int) -> int:
+    """rsync-style block size: ~sqrt(size), pow2-bounded [4 KiB, 128 KiB]."""
+    if size <= 0:
+        return MIN_BLOCK
+    target = int(size ** 0.5)
+    b = MIN_BLOCK
+    while b < target and b < MAX_BLOCK:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class FileSignature:
+    size: int
+    block_len: int
+    weak: np.ndarray          # [nb] uint32 (includes short tail block)
+    strong: list[bytes]       # [nb] 16-byte MD5 digests
+
+    def to_wire(self) -> dict:
+        return {"size": self.size, "block_len": self.block_len,
+                "weak": self.weak.tobytes(),
+                "strong": b"".join(self.strong)}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "FileSignature":
+        weak = np.frombuffer(d["weak"], dtype=np.uint32).copy()
+        strong = [d["strong"][i : i + 16]
+                  for i in range(0, len(d["strong"]), 16)]
+        return cls(size=d["size"], block_len=d["block_len"], weak=weak,
+                   strong=strong)
+
+
+def build_file_signature(data: bytes,
+                         block_len: Optional[int] = None) -> FileSignature:
+    """Destination side: checksum every block (device for the full blocks,
+    host for the short tail)."""
+    import jax.numpy as jnp
+
+    block_len = block_len or pick_block_len(len(data))
+    if len(data) == 0:
+        return FileSignature(0, block_len, np.zeros((0,), np.uint32), [])
+    arr = np.frombuffer(data, np.uint8)
+    n_full = len(data) // block_len
+    if n_full == 0:
+        weak = np.array([weak_checksum_host(data)], dtype=np.uint32)
+        return FileSignature(len(data), block_len, weak,
+                             [hashlib.md5(data).digest()])
+    dev = jnp.asarray(arr)
+    weak_dev, strong_dev = build_signature(dev, block_len=block_len)
+    weak = np.asarray(weak_dev)  # includes tail at its true length
+    strong = [np.asarray(strong_dev)[i].astype("<u4").tobytes()
+              for i in range(n_full)]
+    tail = data[n_full * block_len :]
+    if tail:
+        strong.append(hashlib.md5(tail).digest())
+    else:
+        weak = weak[:n_full]
+    return FileSignature(len(data), block_len, weak, strong)
+
+
+# Delta ops: ("copy", first_block, n_blocks) | ("data", bytes)
+Op = tuple
+
+
+def compute_delta(src: bytes, sig: FileSignature) -> list[Op]:
+    """Source side: the delta scan. Returns ops that rebuild ``src`` from
+    the destination's blocks + literal data."""
+    import jax.numpy as jnp
+
+    L = len(src)
+    if L == 0:
+        return []
+    block_len = sig.block_len
+    n_full_dst = sig.size // block_len
+    # Only full blocks participate in the rolling scan; the destination
+    # tail block (if any) can only match at the very end of src.
+    full_weak = sig.weak[:n_full_dst]
+    if len(full_weak) == 0 or L < block_len:
+        return _with_tail_match(src, sig, [("data", src)])
+
+    arr = np.frombuffer(src, np.uint8)
+    dev = jnp.asarray(arr)
+    sort_idx = np.argsort(full_weak, kind="stable")
+    sorted_weak = full_weak[sort_idx]
+    cap = max(1024, _pow2ceil(L // block_len * 4))
+    while True:
+        cand_dev, count = match_offsets(
+            dev, jnp.asarray(sorted_weak), window=block_len,
+            max_candidates=cap,
+        )
+        n = int(count)
+        if n <= cap:
+            cand = np.asarray(cand_dev)[:n]
+            break
+        cap = _pow2ceil(n)
+    if len(cand) == 0:
+        return _with_tail_match(src, sig, [("data", src)])
+
+    # Strong verification, batched on device.
+    strongs = verify_candidates(dev, cand, block_len=block_len)
+    strong_bytes = [strongs[i].astype("<u4").tobytes()
+                    for i in range(len(cand))]
+    # weak -> destination block ids (handle duplicate weak values)
+    by_weak: dict[int, list[int]] = {}
+    for orig_idx in sort_idx:
+        by_weak.setdefault(int(full_weak[orig_idx]), []).append(int(orig_idx))
+    # offset -> destination block index for verified matches
+    verified: dict[int, int] = {}
+    weak_at = _weak_at_offsets(arr, cand, block_len)
+    for i, off in enumerate(cand):
+        w = weak_at[i]
+        if w not in by_weak:
+            continue
+        for dst_block in by_weak[w]:
+            if sig.strong[dst_block] == strong_bytes[i]:
+                verified[int(off)] = dst_block
+                break
+
+    # Greedy left-to-right selection over sparse verified offsets.
+    ops: list[Op] = []
+    lit_start = 0
+    pos = 0
+    offsets = sorted(verified)
+    oi = 0
+    while pos + block_len <= L:
+        while oi < len(offsets) and offsets[oi] < pos:
+            oi += 1
+        if oi < len(offsets) and offsets[oi] == pos:
+            if lit_start < pos:
+                ops.append(("data", src[lit_start:pos]))
+            blk = verified[pos]
+            if ops and ops[-1][0] == "copy" and (
+                    ops[-1][1] + ops[-1][2] == blk):
+                ops[-1] = ("copy", ops[-1][1], ops[-1][2] + 1)
+            else:
+                ops.append(("copy", blk, 1))
+            pos += block_len
+            lit_start = pos
+        else:
+            # No verified match at pos: jump straight to the next verified
+            # offset instead of advancing byte-by-byte — the unmatched
+            # region is already covered by lit_start, and a per-byte
+            # Python loop would cost O(file bytes) interpreter steps.
+            if oi < len(offsets) and offsets[oi] > pos:
+                pos = offsets[oi]
+            else:
+                break
+    if lit_start < L:
+        ops.append(("data", src[lit_start:]))
+    return _with_tail_match(src, sig, ops)
+
+
+def _with_tail_match(src: bytes, sig: FileSignature,
+                     ops: list[Op]) -> list[Op]:
+    """If src's final bytes equal the destination's short tail block,
+    replace the trailing literal with a copy of the tail block."""
+    n_full = sig.size // sig.block_len
+    tail_len = sig.size - n_full * sig.block_len
+    if tail_len == 0 or n_full >= len(sig.strong):
+        return ops
+    if not ops or ops[-1][0] != "data" or len(ops[-1][1]) < tail_len:
+        return ops
+    lit = ops[-1][1]
+    if hashlib.md5(lit[-tail_len:]).digest() == sig.strong[n_full]:
+        remainder = lit[:-tail_len]
+        ops = ops[:-1]
+        if remainder:
+            ops.append(("data", remainder))
+        ops.append(("copy", n_full, 1))
+    return ops
+
+
+def apply_delta(ops: list[Op], dest: bytes, block_len: int) -> bytes:
+    """Destination side: rebuild the file from its own blocks + literals."""
+    out = bytearray()
+    for op in ops:
+        if op[0] == "data":
+            out += op[1]
+        else:
+            _, first, count = op
+            start = first * block_len
+            out += dest[start : start + count * block_len]
+    return bytes(out)
+
+
+def delta_stats(ops: list[Op], block_len: int) -> dict:
+    copied = sum(op[2] * block_len for op in ops if op[0] == "copy")
+    literal = sum(len(op[1]) for op in ops if op[0] == "data")
+    return {"copied_bytes": copied, "literal_bytes": literal}
+
+
+def _pow2ceil(n: int) -> int:
+    v = 1
+    while v < n:
+        v *= 2
+    return v
+
+
+def _weak_at_offsets(arr: np.ndarray, offsets, block_len: int) -> np.ndarray:
+    """Weak checksums at given offsets via numpy prefix sums (vectorized;
+    identical arithmetic to ops/rolling.py)."""
+    if len(offsets) == 0:
+        return np.zeros((0,), np.uint32)
+    x = arr.astype(np.uint32)
+    j = np.arange(len(arr), dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        S = np.concatenate([[0], np.cumsum(x, dtype=np.uint32)])
+        T = np.concatenate([[0], np.cumsum(j * x, dtype=np.uint32)])
+        off = np.asarray(offsets, dtype=np.int64)
+        dS = S[off + block_len] - S[off]
+        dT = T[off + block_len] - T[off]
+        a = dS & np.uint32(0xFFFF)
+        b = ((off.astype(np.uint32) + np.uint32(block_len)) * dS - dT) & np.uint32(0xFFFF)
+    return (a | (b << np.uint32(16))).astype(np.uint32)

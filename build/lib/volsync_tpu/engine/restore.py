@@ -1,0 +1,146 @@
+"""Snapshot restore (the `restic restore` equivalent).
+
+What `/entry.sh restore` does in the reference (mover-restic/
+entry.sh:203-229): select a snapshot by RESTORE_AS_OF / SELECT_PREVIOUS
+(here: Repository.select_snapshot), then materialize its tree into the
+target volume. Restores are idempotent: existing files matching the
+snapshot entry's size+mtime_ns are skipped (mode still re-applied), and
+extra files in the target can optionally be deleted (--delete semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+from volsync_tpu.repo.repository import Repository
+
+
+class TreeRestore:
+    def __init__(self, repo: Repository, *, workers: Optional[int] = None):
+        """``workers`` restores that many files concurrently (default 4,
+        env VOLSYNC_RESTORE_WORKERS): blob reads (store IO + decrypt)
+        overlap file writes across independent files. Directory
+        modes/mtimes are applied in a bottom-up pass AFTER every file
+        write, so concurrent writes can't bump an already-stamped parent
+        mtime."""
+        self.repo = repo
+        if workers is None:
+            workers = int(os.environ.get("VOLSYNC_RESTORE_WORKERS", "4"))
+        self.workers = max(1, workers)
+
+    def run(self, snap_id: str, manifest: dict, dest,
+            *, delete_extra: bool = True) -> dict:
+        # Shared lock: a concurrent exclusive prune must not repack and
+        # delete the packs this restore is mid-way through reading.
+        # restore_snapshot() already holds the lock and calls _run_locked
+        # directly (selection and walk under ONE lock, not two).
+        with self.repo.lock(exclusive=False):
+            return self._run_locked(snap_id, manifest, dest,
+                                    delete_extra=delete_extra)
+
+    def _run_locked(self, snap_id: str, manifest: dict, dest,
+                    *, delete_extra: bool = True) -> dict:
+        dest = Path(dest)
+        dest.mkdir(parents=True, exist_ok=True)
+        stats = {"files": 0, "bytes": 0, "skipped": 0, "deleted": 0}
+        jobs: list[tuple[dict, Path]] = []
+        dirs: list[tuple[Path, dict]] = []
+        self._walk_tree(manifest["tree"], dest, stats, jobs, dirs,
+                        delete_extra=delete_extra)
+        if jobs:
+            if self.workers > 1 and len(jobs) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(self.workers) as pool:
+                    results = list(pool.map(
+                        lambda j: self._restore_file(*j), jobs))
+            else:
+                results = [self._restore_file(*j) for j in jobs]
+            for key, nbytes in results:
+                stats[key] += 1
+                stats["bytes"] += nbytes
+        # Directory metadata last, children-first: any earlier write
+        # inside a directory would overwrite its restored mtime.
+        for path, entry in reversed(dirs):
+            os.chmod(path, entry["mode"])
+            os.utime(path, ns=(entry["mtime_ns"], entry["mtime_ns"]))
+        return stats
+
+    def _walk_tree(self, tree_id: str, dirpath: Path, stats: dict,
+                   jobs: list, dirs: list, *, delete_extra: bool):
+        tree = json.loads(self.repo.read_blob(tree_id))
+        wanted = {e["name"] for e in tree["entries"]}
+        if delete_extra:
+            for child in dirpath.iterdir():
+                if child.name not in wanted:
+                    _rmtree(child)
+                    stats["deleted"] += 1
+        for entry in tree["entries"]:
+            target = dirpath / entry["name"]
+            if entry["type"] == "dir":
+                if target.is_symlink() or (target.exists() and not target.is_dir()):
+                    target.unlink()
+                target.mkdir(exist_ok=True)
+                dirs.append((target, entry))
+                self._walk_tree(entry["subtree"], target, stats, jobs,
+                                dirs, delete_extra=delete_extra)
+            elif entry["type"] == "symlink":
+                if target.is_symlink() or target.exists():
+                    _rmtree(target)
+                os.symlink(entry["target"], target)
+                os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]),
+                         follow_symlinks=False)
+            elif entry["type"] == "file":
+                jobs.append((entry, target))
+
+    def _restore_file(self, entry: dict, target: Path) -> tuple[str, int]:
+        if (target.is_file() and not target.is_symlink()
+                and target.stat().st_size == entry["size"]
+                and target.stat().st_mtime_ns == entry["mtime_ns"]):
+            # Content is trusted unchanged (size+mtime_ns, the same
+            # heuristic backup uses), but mode can drift without touching
+            # mtime (chmod updates only ctime) — re-apply it.
+            os.chmod(target, entry["mode"])
+            return "skipped", 0
+        if target.is_symlink() or target.is_dir():
+            _rmtree(target)
+        with open(target, "wb") as f:
+            for blob_id in entry["content"]:
+                f.write(self.repo.read_blob(blob_id))
+        os.chmod(target, entry["mode"])
+        os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
+        return "files", entry["size"]
+
+
+def _rmtree(path: Path):
+    import shutil
+
+    if path.is_symlink() or path.is_file():
+        path.unlink()
+    else:
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def restore_snapshot(repo: Repository, dest, *,
+                     restore_as_of=None, previous: int = 0,
+                     delete_extra: bool = True) -> Optional[dict]:
+    """Select + restore in one call; returns stats or None if no snapshot
+    matches the selectors.
+
+    Selection happens under the same shared lock as the tree walk (shared
+    locks nest), and the index is re-read once locked — otherwise a prune
+    between select and walk could delete the chosen snapshot's packs and
+    the restore would die mid-way with delete_extra damage already done.
+    """
+    with repo.lock(exclusive=False):
+        repo.load_index()
+        selected = repo.select_snapshot(restore_as_of=restore_as_of,
+                                        previous=previous)
+        if selected is None:
+            return None
+        snap_id, manifest = selected
+        return TreeRestore(repo)._run_locked(snap_id, manifest, dest,
+                                             delete_extra=delete_extra)

@@ -1,0 +1,9 @@
+"""Native IO runtime (C++ readahead reader + host hot loops, ctypes)."""
+
+from volsync_tpu.io.native import (
+    ReadaheadReader,
+    available,
+    select_boundaries_native,
+)
+
+__all__ = ["ReadaheadReader", "available", "select_boundaries_native"]

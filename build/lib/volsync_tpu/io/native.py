@@ -1,0 +1,180 @@
+"""ctypes bindings for the native IO/runtime library (native/volio.cpp).
+
+The compute path is JAX/Pallas; this is the native runtime AROUND it:
+a C++ readahead file reader (disk IO overlapped with device hashing)
+and the C FastCDC boundary walk. Built on demand with g++ into a cached
+shared object (no pybind11 in the image; plain C ABI + ctypes). Every
+entry point has a pure-Python fallback — ``available()`` gates use, and
+VOLSYNC_NO_NATIVE=1 disables the library outright.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("volsync_tpu.native")
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "volio.cpp"
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build(src: Path, out: Path) -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           "-o", str(out), str(src)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build failed to run: %s", e)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed:\n%s", proc.stderr[-2000:])
+        return False
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("VOLSYNC_NO_NATIVE"):
+            return None
+        prebuilt = os.environ.get("VOLSYNC_VOLIO_SO")
+        if prebuilt:
+            # Container images ship the library pre-compiled (Dockerfile
+            # builder stage) — no compiler in the runtime image.
+            try:
+                lib = ctypes.CDLL(prebuilt)
+                _bind(lib)  # stale/wrong .so: missing symbols degrade
+            except (OSError, AttributeError) as e:
+                log.warning("prebuilt native load failed (%s): %s",
+                            prebuilt, e)
+                return None
+            _LIB = lib
+            return _LIB
+        if not _SRC.is_file():
+            return None
+        cache = Path(os.environ.get("VOLSYNC_NATIVE_CACHE",
+                                    str(_SRC.parent / "build")))
+        cache.mkdir(parents=True, exist_ok=True)
+        so = cache / "libvolio.so"
+        if (not so.is_file()
+                or so.stat().st_mtime < _SRC.stat().st_mtime):
+            # Build to a temp name and rename into place: concurrent
+            # processes sharing the cache must never dlopen a half-
+            # written .so.
+            tmp = cache / f".libvolio.{os.getpid()}.so"
+            if not _build(_SRC, tmp):
+                return None
+            os.replace(tmp, so)
+        try:
+            lib = ctypes.CDLL(str(so))
+            _bind(lib)
+        except (OSError, AttributeError) as e:
+            log.warning("native load failed: %s", e)
+            return None
+        _LIB = lib
+        log.info("native volio loaded from %s", so)
+        return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.volio_open.restype = ctypes.c_void_p
+    lib.volio_open.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.volio_next.restype = ctypes.c_int64
+    lib.volio_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.volio_close.restype = None
+    lib.volio_close.argtypes = [ctypes.c_void_p]
+    lib.volio_select_boundaries.restype = ctypes.c_int64
+    lib.volio_select_boundaries.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class ReadaheadReader:
+    """reader(n)-compatible streaming file reader with a C++ readahead
+    thread: the next segment is on its way up from disk while the caller
+    processes the current one."""
+
+    def __init__(self, path, segment_size: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native volio unavailable")
+        self._lib = lib
+        self._segment = segment_size
+        self._buf = ctypes.create_string_buffer(segment_size)
+        self._handle = lib.volio_open(str(path).encode(), segment_size)
+        if not self._handle:
+            raise OSError(f"volio_open failed for {path}")
+        self._carry = b""
+        self._eof = False
+
+    def read(self, n: int) -> bytes:
+        """Return up to n bytes (b'' at EOF) — the stream_chunks reader
+        contract. Segments stream in whole; the carry bridges sizes."""
+        while not self._eof and len(self._carry) < n:
+            got = self._lib.volio_next(self._handle, self._buf)
+            if got < 0:
+                raise OSError("volio_next failed")
+            if got == 0:
+                self._eof = True
+                break
+            # ctypes slice copies exactly `got` bytes (.raw would copy
+            # the whole segment buffer first).
+            self._carry += self._buf[:got]
+        out, self._carry = self._carry[:n], self._carry[n:]
+        return out
+
+    def close(self):
+        if self._handle:
+            self._lib.volio_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def select_boundaries_native(idx_s, idx_l, length: int, params,
+                             eof: bool, base: int = 0
+                             ) -> Optional[list]:
+    """The C FastCDC walk; None if the library is unavailable (caller
+    falls back to the Python walk — golden tests pin their equality)."""
+    lib = _load()
+    if lib is None:
+        return None
+    a_s = np.ascontiguousarray(np.asarray(idx_s, dtype=np.int64))
+    a_l = np.ascontiguousarray(np.asarray(idx_l, dtype=np.int64))
+    cap = max(length // params.min_size + 2, 16)
+    out = np.empty((cap * 2,), dtype=np.int64)
+    n = lib.volio_select_boundaries(
+        a_s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(a_s),
+        a_l.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(a_l),
+        length, params.min_size, params.avg_size, params.max_size,
+        1 if eof else 0, base,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+    if n < 0:
+        return None  # capacity bug; be safe and fall back
+    return [(int(out[2 * k]), int(out[2 * k + 1])) for k in range(n)]

@@ -1,0 +1,141 @@
+"""Prometheus metrics, mirroring controllers/metrics.go:38-72.
+
+Namespace ``volsync``: ``missed_intervals_total`` (counter),
+``volume_out_of_sync`` (gauge), ``sync_duration_seconds`` (histogram here —
+prometheus_client has no server-side quantile summary; the reference's
+.5/.9/.99 summary quantiles become histogram buckets sized for sync
+durations), labeled obj_name/obj_namespace/role/method. A fourth,
+TPU-specific family ``data_throughput_bytes_per_second`` tracks the
+device-pipeline rate the reference could never observe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+LABELS = ["obj_name", "obj_namespace", "role", "method"]
+
+_BUCKETS = (0.1, 0.5, 1, 5, 15, 30, 60, 120, 300, 600, 1800, 3600, float("inf"))
+
+
+class Metrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.missed_intervals = Counter(
+            "volsync_missed_intervals_total",
+            "The number of times a synchronization failed to complete "
+            "before the next scheduled start",
+            LABELS, registry=self.registry,
+        )
+        self.out_of_sync = Gauge(
+            "volsync_volume_out_of_sync",
+            "Set to 1 if the volume is not properly synchronized",
+            LABELS, registry=self.registry,
+        )
+        self.sync_durations = Histogram(
+            "volsync_sync_duration_seconds",
+            "Duration of the synchronization interval in seconds",
+            LABELS, registry=self.registry, buckets=_BUCKETS,
+        )
+        self.throughput = Gauge(
+            "volsync_data_throughput_bytes_per_second",
+            "Device data-plane throughput of the last completed transfer",
+            LABELS, registry=self.registry,
+        )
+
+    def for_object(self, name: str, namespace: str, role: str,
+                   method: str) -> "BoundMetrics":
+        labels = dict(obj_name=name, obj_namespace=namespace, role=role,
+                      method=method)
+        return BoundMetrics(
+            missed_intervals=self.missed_intervals.labels(**labels),
+            out_of_sync=self.out_of_sync.labels(**labels),
+            sync_durations=self.sync_durations.labels(**labels),
+            throughput=self.throughput.labels(**labels),
+        )
+
+    def expose(self) -> bytes:
+        """Text exposition (the reference serves this on :8080/metrics)."""
+        return generate_latest(self.registry)
+
+
+@dataclasses.dataclass
+class BoundMetrics:
+    """Per-CR labeled children (what the state machine drives)."""
+
+    missed_intervals: object
+    out_of_sync: object
+    sync_durations: object
+    throughput: object
+
+
+class MetricsServer:
+    """HTTP exposition + probes, the analogue of the reference manager's
+    metrics listener on :8080 and healthz/readyz probes on :8081
+    (controllers/metrics.go:82-85, main.go:140-153). One server carries
+    all three endpoints; ``port=0`` binds an ephemeral port (tests)."""
+
+    def __init__(self, metrics: "Metrics", host: str = "127.0.0.1",
+                 port: int = 8080,
+                 ready_check=None):
+        import http.server
+        import threading
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/metrics":
+                    body = outer.metrics.expose()
+                    ctype = "text/plain; version=0.0.4"
+                    code = 200
+                elif self.path == "/healthz":
+                    body, ctype, code = b"ok", "text/plain", 200
+                elif self.path == "/readyz":
+                    ok = outer.ready_check is None or outer.ready_check()
+                    body = b"ok" if ok else b"not ready"
+                    ctype, code = "text/plain", (200 if ok else 503)
+                else:
+                    body, ctype, code = b"not found", "text/plain", 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self.metrics = metrics
+        self.ready_check = ready_check
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-server")
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+GLOBAL = Metrics()

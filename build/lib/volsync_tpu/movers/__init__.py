@@ -1,0 +1,26 @@
+"""Mover plugin layer: catalog + concrete movers.
+
+Mirrors controllers/mover/ (SURVEY.md §2 #9-14). Concrete movers register
+themselves into ``CATALOG`` via their ``register()`` functions, exactly
+like the reference's ``registerMovers`` in main.go:67-81.
+"""
+
+from volsync_tpu.movers.base import (
+    CATALOG,
+    Builder,
+    Catalog,
+    Mover,
+    MultipleMoversFound,
+    NoMoverFound,
+    Result,
+)
+
+__all__ = [
+    "CATALOG",
+    "Builder",
+    "Catalog",
+    "Mover",
+    "MultipleMoversFound",
+    "NoMoverFound",
+    "Result",
+]

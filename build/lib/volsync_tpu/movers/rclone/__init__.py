@@ -1,0 +1,12 @@
+"""rclone-equivalent mover: checksum-based bucket mirroring.
+
+Control plane: builder.py (controllers/mover/rclone/).
+Data plane: entry.py + sync.py (mover-rclone/active.sh).
+"""
+
+from volsync_tpu.movers.rclone.builder import (  # noqa: F401
+    Builder,
+    RcloneDestinationMover,
+    RcloneSourceMover,
+    register,
+)

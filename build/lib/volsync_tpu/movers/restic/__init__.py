@@ -1,0 +1,12 @@
+"""restic-equivalent mover: deduplicating backup/restore to object storage.
+
+Control plane mirrors controllers/mover/restic/ (cache volume, repository
+secret validation, backup/prune on the source, restore with
+restoreAsOf/previous on the destination); the data plane is the TPU
+engine (engine/backup.py, engine/restore.py) instead of a wrapped binary.
+"""
+
+from volsync_tpu.movers.restic.builder import Builder, register
+from volsync_tpu.movers.restic.entry import restic_entrypoint
+
+__all__ = ["Builder", "register", "restic_entrypoint"]

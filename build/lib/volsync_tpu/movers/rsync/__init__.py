@@ -1,0 +1,15 @@
+"""rsync-equivalent mover: authenticated push delta-sync to a listening
+destination.
+
+Mirrors controllers/mover/rsync/ (SURVEY.md §2 #10, #23-24): the
+destination exposes an addressed listener whose connection keys live in a
+generated Secret and whose address/keys are published in status; the
+source pushes a whole-tree delta over the mutually-authenticated channel
+with bounded retries, then tells the listener to shut down with the
+transfer's exit code. The delta scan itself runs on TPU
+(engine/deltasync.py) instead of inside the rsync binary.
+"""
+
+from volsync_tpu.movers.rsync.builder import Builder, register
+
+__all__ = ["Builder", "register"]

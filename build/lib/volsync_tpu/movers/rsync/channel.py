@@ -1,0 +1,134 @@
+"""Mutually-authenticated framed channel (the SSH-tunnel analogue).
+
+The reference secures its data channel with SSH: generated keypairs in
+Secrets, mutual pubkey auth, and a forced command restricting the remote
+to exactly two verbs (mover-rsync/destination-command.sh:23-33). This
+channel keeps that security envelope with the primitives at hand: a
+32-byte pre-shared key from the generated Secret, per-frame
+AES-256-CTR + HMAC-SHA256 sealing (repo/crypto.py), a key-possession
+handshake both ways, and a server loop that dispatches only a fixed verb
+table — anything else closes the connection.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import socket
+import struct
+from typing import Callable, Optional
+
+import msgpack
+
+from volsync_tpu.repo.crypto import IntegrityError, SecretBox
+
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+class ChannelError(RuntimeError):
+    pass
+
+
+def box_from_key(key: bytes) -> SecretBox:
+    """Derive directional-agnostic enc/mac keys from the shared secret."""
+    enc = hmac_mod.new(key, b"volsync-channel-enc", hashlib.sha256).digest()
+    mac = hmac_mod.new(key, b"volsync-channel-mac", hashlib.sha256).digest()
+    return SecretBox(enc, mac)
+
+
+class Framed:
+    """Sealed, length-prefixed msgpack frames over a socket."""
+
+    def __init__(self, sock: socket.socket, box: SecretBox):
+        self.sock = sock
+        self.box = box
+
+    def send(self, obj) -> None:
+        payload = self.box.seal(msgpack.packb(obj, use_bin_type=True))
+        self.sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+    def recv(self):
+        header = self._read_exact(4)
+        (n,) = struct.unpack(">I", header)
+        if n > _MAX_FRAME:
+            raise ChannelError(f"frame too large: {n}")
+        try:
+            plain = self.box.open(self._read_exact(n))
+        except IntegrityError as e:
+            raise ChannelError(f"authentication failure: {e}") from None
+        return msgpack.unpackb(plain, raw=False)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            piece = self.sock.recv(n - len(buf))
+            if not piece:
+                raise ChannelError("peer closed connection")
+            buf += piece
+        return buf
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def client_connect(address: str, port: int, key: bytes,
+                   timeout: float = 10.0) -> Framed:
+    sock = socket.create_connection((address, port), timeout=timeout)
+    sock.settimeout(timeout)
+    ch = Framed(sock, box_from_key(key))
+    nonce = os.urandom(16)
+    ch.send({"verb": "hello", "nonce": nonce})
+    reply = ch.recv()  # decrypting proves the server holds the key
+    if reply.get("verb") != "hello-ack" or reply.get("nonce") != nonce:
+        ch.close()
+        raise ChannelError("handshake failed")
+    return ch
+
+
+def serve_channel(ch: Framed,
+                  verbs: dict[str, Callable[[dict], dict]]) -> Optional[int]:
+    """Serve verbs over an ALREADY-authenticated channel (PSK hello or
+    the device-transport DH handshake). Returns the rc passed to the
+    ``shutdown`` verb, or None if the peer just disconnected. Unknown
+    verbs terminate the session (forced-command discipline)."""
+    try:
+        while True:
+            try:
+                msg = ch.recv()
+            except (ChannelError, OSError):
+                # Includes socket.timeout: a stalled peer drops ITS
+                # session; the listener's accept loop must survive.
+                return None
+            verb = msg.get("verb")
+            if verb == "shutdown":
+                ch.send({"verb": "ok"})
+                return int(msg.get("rc", 0))
+            handler = verbs.get(verb)
+            if handler is None:
+                return None  # not in the allowed verb table: hang up
+            ch.send(handler(msg))
+    finally:
+        ch.close()
+
+
+def serve_session(conn: socket.socket, key: bytes,
+                  verbs: dict[str, Callable[[dict], dict]],
+                  timeout: float = 30.0) -> Optional[int]:
+    """Serve one PSK-authenticated session. ``verbs`` maps verb name ->
+    handler(msg)->reply; MAC failures terminate immediately."""
+    conn.settimeout(timeout)
+    ch = Framed(conn, box_from_key(key))
+    try:
+        hello = ch.recv()  # MAC-validated: proves the client holds the key
+        if hello.get("verb") != "hello":
+            ch.close()
+            return None
+        ch.send({"verb": "hello-ack", "nonce": hello.get("nonce")})
+    except ChannelError:
+        ch.close()
+        return None
+    return serve_channel(ch, verbs)

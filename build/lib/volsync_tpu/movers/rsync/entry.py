@@ -1,0 +1,285 @@
+"""rsync mover data-plane entrypoints (source.sh / destination.sh
+analogues).
+
+Destination: bind a listener, publish the bound port on the mover
+Service, then serve authenticated sessions restricted to the sync verb
+table until the source's ``shutdown <rc>`` arrives — the process exits
+with that rc, exactly like the forced-command sshd wrapper
+(mover-rsync/destination.sh:19-27, destination-command.sh:4-17).
+
+Source: connect with bounded exponential-backoff retries
+(mover-rsync/source.sh:43-62), push a whole-tree delta (TPU delta scan,
+engine/deltasync.py), then send shutdown with the transfer rc.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import stat as stat_mod
+import time
+from pathlib import Path
+
+from volsync_tpu.engine import deltasync
+from volsync_tpu.movers.rsync import channel
+
+log = logging.getLogger("volsync_tpu.mover.rsync")
+
+MAX_RETRIES = 5  # source.sh:43 (5 attempts, doubling backoff)
+
+
+# ---------------------------------------------------------------------------
+# Destination
+# ---------------------------------------------------------------------------
+
+
+def _dest_verbs(root: Path):
+    def sig(msg):
+        path = _safe_join(root, msg["path"])
+        if not path.is_file() or path.is_symlink():
+            return {"verb": "sig", "exists": False}
+        data = path.read_bytes()
+        s = deltasync.build_file_signature(
+            data, msg.get("block_len") or None)
+        return {"verb": "sig", "exists": True, **s.to_wire()}
+
+    def apply(msg):
+        path = _safe_join(root, msg["path"])
+        old = b""
+        if path.is_file() and not path.is_symlink():
+            old = path.read_bytes()
+        ops = [tuple(op) if op[0] == "copy" else ("data", op[1])
+               for op in msg["ops"]]
+        new = deltasync.apply_delta(ops, old, msg["block_len"])
+        path.parent.mkdir(parents=True, exist_ok=True)
+        if path.is_dir() or path.is_symlink():
+            _rm(path)
+        path.write_bytes(new)
+        os.chmod(path, msg["mode"])
+        os.utime(path, ns=(msg["mtime_ns"], msg["mtime_ns"]))
+        return {"verb": "ok", "size": len(new)}
+
+    def mkdir(msg):
+        path = _safe_join(root, msg["path"])
+        if path.is_symlink() or (path.exists() and not path.is_dir()):
+            _rm(path)
+        path.mkdir(parents=True, exist_ok=True)
+        os.chmod(path, msg["mode"])
+        return {"verb": "ok"}
+
+    def symlink(msg):
+        path = _safe_join(root, msg["path"])
+        if path.is_symlink() or path.exists():
+            _rm(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        os.symlink(msg["target"], path)
+        return {"verb": "ok"}
+
+    def prune(msg):
+        """--delete semantics: remove everything not in the keep set."""
+        keep = set(msg["paths"])
+        removed = 0
+        for dirpath, dirs, files in os.walk(root, topdown=False):
+            for name in files + dirs:
+                p = Path(dirpath, name)
+                rel = str(p.relative_to(root))
+                if rel not in keep:
+                    _rm(p)
+                    removed += 1
+        return {"verb": "ok", "removed": removed}
+
+    return {"sig": sig, "apply": apply, "mkdir": mkdir,
+            "symlink": symlink, "prune": prune}
+
+
+def serve_destination(root: Path, dst_private: bytes, source_id: str,
+                      *, bind: str = "127.0.0.1", preferred_port: int = 0,
+                      stop_event=None, on_port=None) -> int:
+    """The listener proper: accept device-authenticated sessions from the
+    pinned source device and serve the sync verb table until the source's
+    ``shutdown <rc>`` arrives; that rc becomes the exit code, exactly like
+    the forced-command sshd wrapper (destination.sh:19-27).
+
+    ``bind`` un-loopbacks the listener for cross-host deployment
+    (BIND_ADDRESS env in the mover contract; the standalone listener
+    binds 0.0.0.0)."""
+    from volsync_tpu.movers import devicetransport as dt
+
+    try:
+        server = socket.create_server((bind, preferred_port))
+    except OSError:
+        server = socket.create_server((bind, 0))
+    port = server.getsockname()[1]
+    if on_port is not None:
+        on_port(port)
+    log.info("rsync destination listening on %s:%d", bind, port)
+    server.settimeout(0.5)
+    verbs = _dest_verbs(Path(root))
+    try:
+        while stop_event is None or not stop_event.is_set():
+            try:
+                conn, _ = server.accept()
+            except socket.timeout:
+                continue
+            out = dt.accept_device(conn, dst_private, {source_id})
+            if out is None:
+                continue  # unknown/failed device: refused at handshake
+            ch, _peer = out
+            rc = channel.serve_channel(ch, verbs)
+            if rc is not None:  # source sent shutdown <rc>
+                return rc
+        return 1  # stopped without a completed transfer
+    finally:
+        server.close()
+
+
+def rsync_destination_entrypoint(ctx) -> int:
+    root = ctx.mounts["data"]
+    keys = ctx.secrets["keys"]
+    # Reuse the previously-published port so the address the source was
+    # configured with stays valid across sync iterations (the reference's
+    # Service port is stable for the same reason); fall back to an
+    # ephemeral port only on first start or if the old port is taken.
+    preferred = 0
+    svc_name = ctx.env.get("SERVICE")
+    if svc_name and ctx.cluster is not None:
+        svc = ctx.cluster.try_get("Service", ctx.namespace, svc_name)
+        if svc is not None and svc.status.bound_port:
+            preferred = svc.status.bound_port
+    return serve_destination(
+        Path(root), keys["destination"], keys["source-id"].decode(),
+        bind=ctx.env.get("BIND_ADDRESS", "127.0.0.1"),
+        preferred_port=preferred, stop_event=ctx.stop_event,
+        on_port=lambda port: _publish_port(ctx, port))
+
+
+def _publish_port(ctx, port: int):
+    """Publish the bound port on the mover Service (the pod's analogue of
+    a named containerPort feeding Service endpoints)."""
+    svc_name = ctx.env.get("SERVICE")
+    if not svc_name or ctx.cluster is None:
+        return
+    svc = ctx.cluster.try_get("Service", ctx.namespace, svc_name)
+    if svc is not None:
+        svc.status.bound_port = port
+        if svc.spec.type == "LoadBalancer":
+            svc.status.load_balancer_ip = "127.0.0.1"
+        svc.status.cluster_ip = "127.0.0.1"
+        ctx.cluster.update_status(svc)
+
+
+# ---------------------------------------------------------------------------
+# Source
+# ---------------------------------------------------------------------------
+
+
+def rsync_source_entrypoint(ctx) -> int:
+    from volsync_tpu.movers import devicetransport as dt
+
+    root = Path(ctx.mounts["data"])
+    keys = ctx.secrets["keys"]
+    src_private = keys["source"]
+    dest_id = keys["destination-id"].decode()
+    address = ctx.env["ADDRESS"]
+    port = int(ctx.env["PORT"])
+
+    delay = 2.0
+    last_err = None
+    for attempt in range(MAX_RETRIES):  # source.sh:43-62
+        if ctx.stop_event.is_set():
+            return 1
+        try:
+            # Mutual device auth: we pin the destination's ID, it pins
+            # ours — neither side ever held the other's private key.
+            ch = dt.connect_device(address, port, src_private, dest_id)
+            try:
+                t0 = time.perf_counter()
+                stats = _push_tree(ch, root)
+                ch.send({"verb": "shutdown", "rc": 0})
+                ch.recv()
+                log.info("rsync push complete: %s", stats)
+                ctx.report_transfer(stats.get("bytes", 0),
+                                    time.perf_counter() - t0)
+                return 0
+            finally:
+                ch.close()
+        except (OSError, channel.ChannelError) as e:
+            last_err = e
+            log.warning("attempt %d failed: %s; retrying in %.0fs",
+                        attempt + 1, e, delay)
+            time.sleep(min(delay, 1.0) if ctx.env.get("FAST_RETRY")
+                       else delay)
+            delay *= 2
+    log.error("rsync push failed after %d attempts: %s", MAX_RETRIES,
+              last_err)
+    return 1
+
+
+def _push_tree(ch, root: Path) -> dict:
+    stats = {"files": 0, "literal_bytes": 0, "copied_bytes": 0, "bytes": 0}
+    keep: list[str] = []
+    for dirpath, dirs, files in os.walk(root):
+        dirs.sort()
+        for name in sorted(files) + dirs:
+            p = Path(dirpath, name)
+            rel = str(p.relative_to(root))
+            keep.append(rel)
+            st = p.lstat()
+            if stat_mod.S_ISLNK(st.st_mode):
+                ch.send({"verb": "symlink", "path": rel,
+                         "target": os.readlink(p)})
+                ch.recv()
+            elif stat_mod.S_ISDIR(st.st_mode):
+                ch.send({"verb": "mkdir", "path": rel,
+                         "mode": st.st_mode & 0o7777})
+                ch.recv()
+            elif stat_mod.S_ISREG(st.st_mode):
+                _push_file(ch, p, rel, st, stats)
+    ch.send({"verb": "prune", "paths": keep})
+    ch.recv()
+    return stats
+
+
+def _push_file(ch, path: Path, rel: str, st, stats: dict):
+    data = path.read_bytes()
+    block_len = deltasync.pick_block_len(max(len(data), st.st_size))
+    ch.send({"verb": "sig", "path": rel, "block_len": block_len})
+    reply = ch.recv()
+    if reply.get("exists"):
+        sig = deltasync.FileSignature.from_wire(reply)
+        ops = deltasync.compute_delta(data, sig)
+        block_len = sig.block_len
+    else:
+        ops = [("data", data)] if data else []
+    wire_ops = [list(op) for op in ops]
+    ch.send({"verb": "apply", "path": rel, "ops": wire_ops,
+             "block_len": block_len, "mode": st.st_mode & 0o7777,
+             "mtime_ns": st.st_mtime_ns})
+    out = ch.recv()
+    if out.get("verb") != "ok":
+        raise channel.ChannelError(f"apply failed for {rel}: {out}")
+    d = deltasync.delta_stats(ops, block_len)
+    stats["files"] += 1
+    stats["bytes"] += len(data)
+    stats["literal_bytes"] += d["literal_bytes"]
+    stats["copied_bytes"] += d["copied_bytes"]
+
+
+# ---------------------------------------------------------------------------
+
+
+def _safe_join(root: Path, rel: str) -> Path:
+    p = (root / rel).resolve()
+    if not str(p).startswith(str(root.resolve()) + os.sep) and p != root.resolve():
+        raise channel.ChannelError(f"path escapes root: {rel!r}")
+    return p
+
+
+def _rm(path: Path):
+    import shutil
+
+    if path.is_symlink() or path.is_file():
+        path.unlink(missing_ok=True)
+    elif path.is_dir():
+        shutil.rmtree(path, ignore_errors=True)

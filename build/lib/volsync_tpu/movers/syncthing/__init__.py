@@ -1,0 +1,15 @@
+"""syncthing mover: always-on N-way live sync (SURVEY.md §2 #13/#14/#28).
+
+The one mover category where the control plane talks to a LIVE service:
+an always-on daemon Deployment block-hashing on the device and exchanging
+files with authenticated peer devices, reconciled against spec.peers
+every poll (controllers/mover/syncthing/ + mover-syncthing/entry.sh).
+"""
+
+from volsync_tpu.movers.syncthing.builder import (
+    Builder,
+    SyncthingMover,
+    register,
+)
+
+__all__ = ["Builder", "SyncthingMover", "register"]

@@ -1,0 +1,16 @@
+"""Device transport (moved): the DH device-identity scheme is shared by
+the syncthing mesh AND the rsync mover's asymmetric-key channel, so it
+lives at volsync_tpu/movers/devicetransport.py; this module re-exports
+for the syncthing-local name."""
+
+from volsync_tpu.movers.devicetransport import (  # noqa: F401
+    DH_G,
+    DH_P,
+    PlainFramed,
+    accept_device,
+    connect_device,
+    device_id,
+    device_id_from_private,
+    generate_device_key,
+    public_key,
+)

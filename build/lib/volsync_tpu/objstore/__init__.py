@@ -1,0 +1,21 @@
+"""Object-store abstraction for repository backends.
+
+The reference's restic/rclone movers talk HTTPS to any S3-compatible
+endpoint via ~35 passthrough env vars (controllers/mover/restic/
+mover.go:317-364). Here the store is a minimal key/value interface with a
+filesystem implementation, an in-memory one for tests, and a real
+SigV4-signing S3 client (objstore/s3.py) with an in-process verifying
+fake server (objstore/fakes3.py — the MinIO-in-kind analogue of
+hack/run-minio.sh).
+"""
+
+from volsync_tpu.objstore.store import (
+    FsObjectStore,
+    MemObjectStore,
+    NoSuchKey,
+    ObjectStore,
+    open_store,
+)
+
+__all__ = ["ObjectStore", "FsObjectStore", "MemObjectStore", "NoSuchKey",
+           "open_store"]

@@ -1,0 +1,336 @@
+"""Key/value object stores: filesystem-backed and in-memory.
+
+Keys are slash-separated paths (``data/ab/abcdef...``). Writes are
+atomic (temp file + rename) so a crashed backup never leaves a torn
+object — the repository layer relies on this for its crash-consistency
+story (objects are immutable once visible, like S3 PUTs).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from pathlib import Path
+from typing import Iterator, Optional, Protocol
+
+
+class ObjectStore(Protocol):
+    def put(self, key: str, data: bytes) -> None: ...
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic create-if-absent; False = the key already exists.
+        Required: Repository.init's no-clobber guarantee rests on it."""
+        ...
+    def get(self, key: str) -> bytes: ...
+    def get_range(self, key: str, offset: int, length: int) -> bytes: ...
+    def exists(self, key: str) -> bool: ...
+    def delete(self, key: str) -> None: ...
+    def list(self, prefix: str = "") -> Iterator[str]: ...
+    def size(self, key: str) -> int: ...
+
+
+def put_file(store, key: str, src) -> None:
+    """Upload a local file as one object with bounded memory when the
+    store supports it (multipart-upload analogue); whole-bytes fallback
+    otherwise."""
+    fn = getattr(store, "put_file", None)
+    if fn is not None:
+        fn(key, src)
+    else:
+        store.put(key, Path(src).read_bytes())
+
+
+def get_file(store, key: str, dst) -> int:
+    """Download an object into a local file with bounded memory when the
+    store supports it; returns bytes written. The write is atomic
+    (temp + rename) so a crashed transfer never leaves a torn file."""
+    fn = getattr(store, "get_file", None)
+    if fn is not None:
+        return fn(key, dst)
+    data = store.get(key)
+    dst = Path(dst)
+    tmp = dst.parent / f".volsync.tmp.{os.getpid()}.{dst.name}"
+    tmp.write_bytes(data)
+    tmp.replace(dst)
+    return len(data)
+
+
+class NoSuchKey(KeyError):
+    pass
+
+
+def _check_key(key: str):
+    parts = key.split("/")
+    if any(p in ("", ".", "..") for p in parts):
+        raise ValueError(f"invalid object key {key!r}")
+
+
+class FsObjectStore:
+    """Directory-backed store; the shape of the S3 bucket the reference's
+    movers write to, minus the network."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        _check_key(key)
+        return self.root / key
+
+    def put(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
+        tmp.write_bytes(data)
+        tmp.rename(p)  # atomic visibility
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic create-if-absent (hard link fails if the target
+        exists): the primitive Repository.init uses so two movers racing
+        to initialize one repository can never clobber each other's
+        config/salt."""
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
+        tmp.write_bytes(data)
+        try:
+            os.link(tmp, p)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            tmp.unlink(missing_ok=True)
+
+    def get(self, key: str) -> bytes:
+        try:
+            return self._path(key).read_bytes()
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        """Ranged read (S3 Range-GET analogue) — how blob fetches avoid
+        pulling whole packs."""
+        try:
+            with open(self._path(key), "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+
+    def exists(self, key: str) -> bool:
+        return self._path(key).is_file()
+
+    def delete(self, key: str) -> None:
+        try:
+            self._path(key).unlink()
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                if f.startswith(".tmp."):
+                    continue
+                key = str(Path(dirpath, f).relative_to(self.root))
+                key = key.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    yield key
+
+    def size(self, key: str) -> int:
+        try:
+            return self._path(key).stat().st_size
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+
+    def put_file(self, key: str, src) -> None:
+        import shutil
+
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
+        shutil.copyfile(src, tmp)
+        tmp.rename(p)
+
+    def get_file(self, key: str, dst) -> int:
+        import shutil
+
+        dst = Path(dst)
+        tmp = dst.parent / f".volsync.tmp.{os.getpid()}.{dst.name}"
+        try:
+            shutil.copyfile(self._path(key), tmp)
+        except FileNotFoundError:
+            raise NoSuchKey(key) from None
+        n = tmp.stat().st_size
+        tmp.replace(dst)
+        return n
+
+
+class MemObjectStore:
+    """In-memory store for unit tests (the fake backend of SURVEY.md §4)."""
+
+    def __init__(self):
+        self._objs: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, data: bytes) -> None:
+        _check_key(key)
+        with self._lock:
+            self._objs[key] = bytes(data)
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        _check_key(key)
+        with self._lock:
+            if key in self._objs:
+                return False
+            self._objs[key] = bytes(data)
+            return True
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            try:
+                return self._objs[key]
+            except KeyError:
+                raise NoSuchKey(key) from None
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        return self.get(key)[offset : offset + length]
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._objs
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._objs.pop(key, None)
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        with self._lock:
+            keys = sorted(self._objs)
+        for k in keys:
+            if k.startswith(prefix):
+                yield k
+
+    def size(self, key: str) -> int:
+        return len(self.get(key))
+
+
+def open_store(url: str, env: Optional[dict] = None) -> ObjectStore:
+    """Open a store by repository URL with credentials from ``env`` —
+    the Secret->env passthrough contract of
+    controllers/mover/restic/mover.go:317-364.
+
+    Supported forms (restic's URL vocabulary):
+      ``s3:http://endpoint/bucket/prefix`` / ``s3://bucket/prefix``,
+      ``azure:container:/path`` (SharedKey client, objstore/azure.py),
+      ``b2:bucket:/path`` (via B2's S3-compatible endpoint),
+      ``gs:bucket:/path`` (via GCS's S3-interop XML API, HMAC keys),
+      ``file:///path``, ``mem:``, or a bare path.
+    ``swift:`` is refused with guidance (no Keystone client) rather
+    than silently misconfigured.
+    """
+    import os as _os
+
+    env_map = dict(_os.environ if env is None else env)
+    if url.startswith("s3:"):
+        from volsync_tpu.objstore.s3 import S3ObjectStore
+
+        return S3ObjectStore.from_url(url, env=env)
+    if url.startswith("azure:"):
+        from volsync_tpu.objstore.azure import AzureBlobStore
+
+        return AzureBlobStore.from_url(url, env_map)
+    if url.startswith("b2:"):
+        return _b2_store(url, env_map)
+    if url.startswith("gs:"):
+        return _gs_store(url, env_map)
+    if url.startswith("swift:") or url.startswith("swift-temp:"):
+        raise ValueError(
+            "swift: repositories are not supported (no Keystone auth "
+            "client); point the repository at your cluster's S3 "
+            "middleware endpoint instead (s3:https://...) — see "
+            "docs/usage/restic.md")
+    if url.startswith("mem:"):
+        return MemObjectStore()
+    if url.startswith("file://"):
+        return FsObjectStore(url[len("file://"):])
+    return FsObjectStore(url)
+
+
+def _bucket_path(url: str, scheme: str) -> tuple[str, str]:
+    """Split restic's ``scheme:bucket:/path`` (or ``scheme:bucket/path``)
+    into (bucket, path)."""
+    rest = url[len(scheme) + 1:]
+    if ":" in rest:
+        bucket, _, path = rest.partition(":")
+    else:
+        bucket, _, path = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"{scheme} URL {url!r} has no bucket")
+    return bucket, path.lstrip("/")
+
+
+def _b2_store(url: str, env: dict) -> ObjectStore:
+    """Backblaze B2 via its S3-compatible endpoint (restic's b2: URL,
+    B2_ACCOUNT_ID/B2_ACCOUNT_KEY env family — mover.go:331-334). B2's
+    S3 endpoint embeds the bucket's region, so it must be given:
+    B2_S3_ENDPOINT explicitly, or derived from B2_REGION."""
+    from volsync_tpu.objstore.s3 import S3ObjectStore
+
+    account = env.get("B2_ACCOUNT_ID", "")
+    key = env.get("B2_ACCOUNT_KEY", "")
+    if not account or not key:
+        raise ValueError(
+            "b2: repository needs B2_ACCOUNT_ID and B2_ACCOUNT_KEY in "
+            "the repository Secret (restic/mover.go:331-334 passthrough); "
+            "use the bucket's S3-compatible application key")
+    endpoint = env.get("B2_S3_ENDPOINT")
+    region = env.get("B2_REGION")
+    if not endpoint and region:
+        endpoint = f"https://s3.{region}.backblazeb2.com"
+    if not endpoint:
+        raise ValueError(
+            "b2: repository needs B2_S3_ENDPOINT (e.g. "
+            "https://s3.us-west-004.backblazeb2.com) or B2_REGION in "
+            "the repository Secret — B2's S3-compatible endpoint is "
+            "region-scoped")
+    if not region:
+        # B2 validates the SigV4 credential-scope region against the
+        # endpoint, so it must match — derive it from the documented
+        # hostname shape rather than defaulting to a wrong value.
+        import re as _re
+
+        m = _re.search(r"//s3\.([a-z0-9-]+)\.backblazeb2\.com", endpoint)
+        if not m:
+            raise ValueError(
+                f"cannot derive the signing region from B2_S3_ENDPOINT="
+                f"{endpoint!r}; set B2_REGION in the repository Secret")
+        region = m.group(1)
+    bucket, path = _bucket_path(url, "b2")
+    return S3ObjectStore(endpoint, bucket, path, access_key=account,
+                         secret_key=key, region=region)
+
+
+def _gs_store(url: str, env: dict) -> ObjectStore:
+    """Google Cloud Storage via the S3-interoperability XML API with
+    HMAC keys (restic's gs: URL). Service-account JSON auth
+    (GOOGLE_APPLICATION_CREDENTIALS) needs RS256 signing, which the
+    stdlib cannot do — refuse with guidance instead of misconfiguring."""
+    from volsync_tpu.objstore.s3 import S3ObjectStore
+
+    access = env.get("GS_ACCESS_KEY_ID") or env.get("AWS_ACCESS_KEY_ID", "")
+    secret = (env.get("GS_SECRET_ACCESS_KEY")
+              or env.get("AWS_SECRET_ACCESS_KEY", ""))
+    if not access or not secret:
+        hint = ""
+        if env.get("GOOGLE_APPLICATION_CREDENTIALS") or \
+                env.get("GOOGLE_PROJECT_ID"):
+            hint = (" — service-account JSON auth is not supported "
+                    "(needs RS256); create HMAC interoperability keys "
+                    "for the bucket and set GS_ACCESS_KEY_ID/"
+                    "GS_SECRET_ACCESS_KEY")
+        raise ValueError(
+            "gs: repository needs GS_ACCESS_KEY_ID and "
+            f"GS_SECRET_ACCESS_KEY in the repository Secret{hint}")
+    endpoint = env.get("GS_S3_ENDPOINT", "https://storage.googleapis.com")
+    bucket, path = _bucket_path(url, "gs")
+    return S3ObjectStore(endpoint, bucket, path, access_key=access,
+                         secret_key=secret, region="auto")

@@ -1,0 +1,10 @@
+"""Observability beyond metrics/events: tracing + profiling (A1)."""
+
+from volsync_tpu.obs.tracing import (
+    device_trace,
+    reset_spans,
+    span,
+    span_totals,
+)
+
+__all__ = ["span", "span_totals", "reset_spans", "device_trace"]

@@ -1,0 +1,92 @@
+"""Tracing/profiling (SURVEY.md §5 A1 — greenfield: the reference has
+only wall-clock echoes in its mover scripts).
+
+Two layers:
+
+- **Spans** — lightweight named timers (``span("backup.candidates")``)
+  recording durations into a process-wide registry AND a Prometheus
+  histogram (``volsync_stage_duration_seconds{stage=...}``) so stage
+  timings ride the same /metrics endpoint as the sync metrics. The
+  movers and the device pipeline mark their phases with these.
+- **Device profiling** — ``device_trace()`` wraps a region with the JAX
+  profiler (TensorBoard/xprof format) when ``VOLSYNC_TRACE_DIR`` is set,
+  capturing XLA op timelines of the hot path on real hardware. Off by
+  default: profiling is opt-in and free when disabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+from prometheus_client import Histogram
+
+from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
+
+_BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 15, 60,
+            float("inf"))
+
+_lock = threading.Lock()
+_totals: dict[str, list] = defaultdict(lambda: [0, 0.0])  # name -> [n, secs]
+_histogram: Optional[Histogram] = None
+
+
+def _hist() -> Histogram:
+    global _histogram
+    with _lock:
+        if _histogram is None:
+            _histogram = Histogram(
+                "volsync_stage_duration_seconds",
+                "Duration of instrumented data-plane stages",
+                ["stage"], registry=GLOBAL_METRICS.registry,
+                buckets=_BUCKETS)
+    return _histogram
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Time a named stage; feeds the span registry + the histogram."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            acc = _totals[name]
+            acc[0] += 1
+            acc[1] += dt
+        _hist().labels(stage=name).observe(dt)
+
+
+def span_totals() -> dict[str, tuple[int, float]]:
+    """{stage: (count, total seconds)} — inspection/tests/CLI."""
+    with _lock:
+        return {k: (v[0], v[1]) for k, v in _totals.items()}
+
+
+def reset_spans():
+    with _lock:
+        _totals.clear()
+
+
+@contextlib.contextmanager
+def device_trace(label: str = "volsync"):
+    """JAX profiler trace of the wrapped region when VOLSYNC_TRACE_DIR is
+    set (TensorBoard 'profile' plugin / xprof reads the output); no-op
+    otherwise."""
+    trace_dir = os.environ.get("VOLSYNC_TRACE_DIR")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    out = os.path.join(trace_dir, label)
+    jax.profiler.start_trace(out)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

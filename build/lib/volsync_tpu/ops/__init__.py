@@ -30,19 +30,15 @@ from volsync_tpu.ops.rolling import (
 )
 from volsync_tpu.ops.delta import build_signature, match_offsets
 from volsync_tpu.ops.segment import (
-    BatchedSegmentHasher,
     FusedSegmentHasher,
     chunk_hash_segment,
-    chunk_hash_segments,
     page_digests,
     span_roots_device,
 )
 
 __all__ = [
-    "BatchedSegmentHasher",
     "FusedSegmentHasher",
     "chunk_hash_segment",
-    "chunk_hash_segments",
     "page_digests",
     "span_roots_device",
     "sha256_blocks",

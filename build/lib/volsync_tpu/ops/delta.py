@@ -1,0 +1,87 @@
+"""Device-side primitives for the rsync-style delta scan.
+
+The reference's delta transfer happens inside the rsync binary (reference:
+mover-rsync/source.sh:54): the destination sends per-block (weak, strong)
+checksums; the source slides the weak checksum over every offset, and on a
+weak match verifies with the strong checksum, emitting copy ops for matched
+blocks and literal bytes for the rest.
+
+TPU mapping: the full rolling-weak scan is one parallel pass
+(volsync_tpu.ops.rolling); membership against the destination's weak set is
+a vectorized binary search (jnp.searchsorted) over the sorted signature;
+candidate offsets are compacted on device; strong verification batches MD5
+over the candidate windows (volsync_tpu.ops.md5.md5_fixed_blocks_device).
+The final greedy left-to-right op selection (sequential, but only over the
+sparse verified matches) runs on host in the engine layer
+(volsync_tpu.engine.deltasync).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from volsync_tpu.ops.md5 import (
+    md5_contiguous_blocks_device,
+    md5_fixed_blocks_device,
+)
+from volsync_tpu.ops.rolling import block_weak_checksums, rolling_weak_checksums
+
+
+def build_signature(data: jax.Array, *, block_len: int):
+    """Destination side: per-block (weak uint32, strong md5 [nb,4] uint32).
+
+    The tail block's strong checksum is computed over its true length by the
+    host wrapper in the engine; here all full blocks are batched on device.
+    """
+    weak = block_weak_checksums(data, block_len=block_len)
+    L = int(data.shape[0])
+    n_full = L // block_len
+    if block_len % 1024 == 0:
+        # The destination's blocks tile the file contiguously: the
+        # strong checksums take the gather-free transposed-lane path
+        # (pick_block_len sizes are always eligible; the windowed
+        # gather kernel stays for sparse match verification and for
+        # caller-chosen odd block sizes).
+        strong = md5_contiguous_blocks_device(
+            jax.lax.slice_in_dim(data, 0, n_full * block_len),
+            block_len=block_len)
+    else:
+        starts = jnp.arange(n_full, dtype=jnp.int32) * block_len
+        strong = md5_fixed_blocks_device(data, starts,
+                                         block_len=block_len)
+    return weak, strong
+
+
+@functools.partial(jax.jit, static_argnames=("window", "max_candidates"))
+def match_offsets(data: jax.Array, sorted_weak: jax.Array, *,
+                  window: int, max_candidates: int):
+    """Source side: offsets whose rolling weak checksum hits the signature.
+
+    data:        [L] uint8 source buffer.
+    sorted_weak: [nb] uint32, destination block weak checksums, sorted.
+    Returns (cand_idx [max_candidates] int32 ascending with L as fill,
+    true_count) — host re-runs with a larger bound on truncation.
+    """
+    L = data.shape[0]
+    if sorted_weak.shape[0] == 0 or L < window:  # static: no possible match
+        return (jnp.full((max_candidates,), L, dtype=jnp.int32),
+                jnp.zeros((), dtype=jnp.int32))
+    weak = rolling_weak_checksums(data, window=window)  # [L-window+1]
+    pos = jnp.searchsorted(sorted_weak, weak)
+    pos = jnp.clip(pos, 0, sorted_weak.shape[0] - 1)
+    hit = sorted_weak[pos] == weak
+    cand = jnp.nonzero(hit, size=max_candidates, fill_value=L)[0]
+    return cand.astype(jnp.int32), jnp.sum(hit)
+
+
+def verify_candidates(data: jax.Array, cand: np.ndarray, *,
+                      block_len: int) -> np.ndarray:
+    """Batch MD5 over candidate windows -> [n, 4] uint32 states (host array)."""
+    if len(cand) == 0:
+        return np.zeros((0, 4), dtype=np.uint32)
+    starts = jnp.asarray(np.asarray(cand, dtype=np.int32))
+    return np.asarray(md5_fixed_blocks_device(data, starts, block_len=block_len))

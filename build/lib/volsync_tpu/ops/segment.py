@@ -80,33 +80,6 @@ def segment_caps(padded_len: int, params: GearParams) -> tuple[int, int]:
     return cand_cap, chunk_cap
 
 
-def _compact_candidates(mask: jax.Array, cand_cap: int, R: int,
-                        align: int) -> jax.Array:
-    """[R] bool candidate mask -> [cand_cap] sorted aligned cut
-    positions, sentinel-padded (sentinel > any valid position). The one
-    compaction used by BOTH the single-segment and batched programs —
-    the sentinel/fill protocol must never drift between them."""
-    sentinel = jnp.int32(2**31 - 2)
-    ridx = jnp.nonzero(mask, size=cand_cap, fill_value=R)[0]
-    return jnp.where(ridx < R,
-                     ridx.astype(jnp.int32) * align + (align - 1),
-                     sentinel)
-
-
-def _apply_tail_overrides(flat: jax.Array, n_pages_pad: int,
-                          tail_pages: jax.Array, tail_digs: jax.Array,
-                          has_tail: jax.Array) -> jax.Array:
-    """Overwrite the word-major page-digest table with per-lane partial
-    tail-leaf digests (lanes with has_tail False write out of bounds ->
-    dropped). tail_pages/has_tail: [N]; tail_digs: [N, 8]. Shared by
-    the single, batched, and span programs so the word-major indexing
-    (digest word j of page p at j*n_pages_pad + p) has ONE home."""
-    j8 = jnp.arange(8, dtype=jnp.int32)[None, :]
-    ovr = jnp.where(has_tail[:, None], j8 * n_pages_pad + tail_pages[:, None],
-                    8 * n_pages_pad)  # OOB -> dropped
-    return flat.at[ovr.reshape(-1)].set(tail_digs.reshape(-1), mode="drop")
-
-
 def _select_boundaries_device(pos_s, ns, pos_l, nl, valid_len, *,
                               min_size: int, avg_size: int, max_size: int,
                               chunk_cap: int, eof: bool):
@@ -142,9 +115,7 @@ def _select_boundaries_device(pos_s, ns, pos_l, nl, valid_len, *,
         cut = jnp.where(found_s, cs,
                         jnp.where(found_l, cl,
                                   jnp.where(hi_ok, hi, L - 1)))
-        # eof may be a static Python bool (single-segment path, part of
-        # the jit cache key) OR a traced per-lane scalar (batched path).
-        emit = found_s | found_l | hi_ok | jnp.asarray(eof, jnp.bool_)
+        emit = found_s | found_l | hi_ok | jnp.bool_(eof)
         # Predicated append: drop the write when not emitting.
         wr = jnp.where(emit, cnt, chunk_cap)
         starts = starts.at[wr].set(pos, mode="drop")
@@ -357,8 +328,15 @@ def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
     ok = pos_all < valid_len
     is_s = ((h & np.uint32(mask_s)) == 0) & ok
     is_l = ((h & np.uint32(mask_l)) == 0) & ok
-    pos_s = _compact_candidates(is_s, cand_cap, R, align)
-    pos_l = _compact_candidates(is_l, cand_cap, R, align)
+    sentinel = jnp.int32(2**31 - 2)  # > any valid cut position
+    ridx_s = jnp.nonzero(is_s, size=cand_cap, fill_value=R)[0]
+    ridx_l = jnp.nonzero(is_l, size=cand_cap, fill_value=R)[0]
+    pos_s = jnp.where(ridx_s < R,
+                      ridx_s.astype(jnp.int32) * align + (align - 1),
+                      sentinel)
+    pos_l = jnp.where(ridx_l < R,
+                      ridx_l.astype(jnp.int32) * align + (align - 1),
+                      sentinel)
     ns = jnp.sum(is_s).astype(jnp.int32)
     nl = jnp.sum(is_l).astype(jnp.int32)
 
@@ -384,9 +362,11 @@ def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
     tail_len = end - tail_page * LEAF_SIZE
     tail_dig = sha256_chunks_device(
         data, (tail_page * LEAF_SIZE)[None],
-        jnp.where(has_tail, tail_len, 0)[None], max_len=LEAF_SIZE)
-    flat = _apply_tail_overrides(flat, n_pages_pad, tail_page[None],
-                                 tail_dig, has_tail[None])
+        jnp.where(has_tail, tail_len, 0)[None], max_len=LEAF_SIZE)[0]
+    ovr = jnp.where(has_tail,
+                    jnp.arange(8, dtype=jnp.int32) * n_pages_pad + tail_page,
+                    8 * n_pages_pad)  # OOB -> dropped
+    flat = flat.at[ovr].set(tail_dig, mode="drop")
 
     # --- roots
     nleaves = jnp.where(live, (lens + (LEAF_SIZE - 1)) // LEAF_SIZE, 0)
@@ -400,108 +380,6 @@ def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
     return jnp.concatenate([
         header, starts.astype(jnp.uint32), lens.astype(jnp.uint32),
         roots.reshape(-1)])
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("min_size", "avg_size", "max_size", "seed", "mask_s",
-                     "mask_l", "align", "cand_cap", "chunk_cap"))
-def chunk_hash_segments(data: jax.Array, valid_len: jax.Array,
-                        eof: jax.Array, *, min_size: int, avg_size: int,
-                        max_size: int, seed: int, mask_s: int, mask_l: int,
-                        align: int, cand_cap: int,
-                        chunk_cap: int) -> jax.Array:
-    """MANY independent segments in ONE device program — the cross-PVC
-    batched form of ``chunk_hash_segment`` (BASELINE configs[5]: many
-    concurrent relationships share one chip; batching their segments
-    into one dispatch replaces S dispatch/fetch round-trips with one).
-
-    data: [S, P] uint8 (each row a zero-padded segment, P % 4096 == 0);
-    valid_len: [S] int32; eof: [S] bool — both TRACED, so one compiled
-    program serves every batch composition. Padding lanes use
-    valid_len == 0. Returns [S, 4 + chunk_cap*10] packed rows, each
-    decodable with ``decode_segment``.
-
-    Stage economics vs S separate dispatches: page hashing runs as ONE
-    Pallas lane batch over all S*P/4096 pages (better MXU/VPU occupancy
-    for small segments), the FastCDC walk vmaps (one masked while_loop
-    to the slowest lane), and root assembly runs as a single
-    S*chunk_cap-lane loop. One fetch returns every stream's chunk
-    table.
-    """
-    assert align == LEAF_SIZE, "fused path requires page-aligned cuts"
-    S, P = data.shape
-    R = P // align
-    F = P // LEAF_SIZE
-    npp = _n_pages_pad(S * F)
-    valid_len = jnp.asarray(valid_len, jnp.int32)
-    eof = jnp.asarray(eof, jnp.bool_)
-
-    flat = data.reshape(S * P)
-    # --- candidates: gear is page-local, so the flat evaluation equals
-    # the per-segment one; masks reshape back to [S, R].
-    h = gear_at_aligned(flat, seed, align).reshape(S, R)
-    pos_all = jnp.arange(R, dtype=jnp.int32) * align + (align - 1)
-    ok = pos_all[None, :] < valid_len[:, None]
-    is_s = ((h & np.uint32(mask_s)) == 0) & ok
-    is_l = ((h & np.uint32(mask_l)) == 0) & ok
-
-    def compact(row):
-        return _compact_candidates(row, cand_cap, R, align)
-
-    pos_s = jax.vmap(compact)(is_s)
-    pos_l = jax.vmap(compact)(is_l)
-    ns = jnp.sum(is_s, axis=1).astype(jnp.int32)
-    nl = jnp.sum(is_l, axis=1).astype(jnp.int32)
-
-    # --- FastCDC walk per lane (vmapped masked while_loop)
-    def walk(ps, n_s, plx, n_l, vl, e):
-        return _select_boundaries_device(
-            ps, jnp.minimum(n_s, cand_cap), plx, jnp.minimum(n_l, cand_cap),
-            vl, min_size=min_size, avg_size=avg_size, max_size=max_size,
-            chunk_cap=chunk_cap, eof=e)
-
-    starts, lens, count, consumed = jax.vmap(walk)(pos_s, ns, pos_l, nl,
-                                                   valid_len, eof)
-
-    # --- page digests: ONE kernel batch over every page of every lane
-    digests = _page_digests_flat(flat, npp)
-
-    # --- per-lane tail override (each lane has at most one partial leaf)
-    live = (jnp.arange(chunk_cap, dtype=jnp.int32)[None, :]
-            < count[:, None])
-    last = jnp.maximum(count - 1, 0)
-    end = jnp.where(count > 0,
-                    jnp.take_along_axis(starts, last[:, None], axis=1)[:, 0]
-                    + jnp.take_along_axis(lens, last[:, None], axis=1)[:, 0],
-                    0)
-    has_tail = (count > 0) & (end % LEAF_SIZE != 0)
-    tail_page_local = jnp.maximum(end - 1, 0) // LEAF_SIZE
-    tail_page = jnp.arange(S, dtype=jnp.int32) * F + tail_page_local
-    tail_len = end - tail_page_local * LEAF_SIZE
-    tail_dig = sha256_chunks_device(
-        flat, jnp.clip(tail_page * LEAF_SIZE, 0, S * P - 1),
-        jnp.where(has_tail, tail_len, 0), max_len=LEAF_SIZE)  # [S, 8]
-    digests = _apply_tail_overrides(digests, npp, tail_page, tail_dig[:S],
-                                    has_tail)
-
-    # --- roots: one flat S*chunk_cap-lane loop over the shared digest
-    # table (page0 offset per lane's segment)
-    nleaves = jnp.where(live, (lens + (LEAF_SIZE - 1)) // LEAF_SIZE, 0)
-    page0 = (starts // LEAF_SIZE
-             + (jnp.arange(S, dtype=jnp.int32) * F)[:, None])
-    roots = _root_digests_loop(
-        digests, npp, page0.reshape(-1), nleaves.reshape(-1),
-        lens.reshape(-1), live.reshape(-1))  # [S*chunk_cap, 8]
-
-    header = jnp.stack([count.astype(jnp.uint32),
-                        consumed.astype(jnp.uint32),
-                        jnp.broadcast_to(nl, count.shape).astype(jnp.uint32),
-                        jnp.sum(nleaves, axis=1).astype(jnp.uint32)],
-                       axis=1)  # [S, 4]
-    return jnp.concatenate([
-        header, starts.astype(jnp.uint32), lens.astype(jnp.uint32),
-        roots.reshape(S, chunk_cap * 8)], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("n_pages_pad",))
@@ -564,8 +442,11 @@ def span_roots_device(data: jax.Array, starts: jax.Array,
     tail_dig = sha256_chunks_device(
         data, jnp.clip(tail_page * LEAF_SIZE, 0, P - 1),
         jnp.where(has_tail, tail_len, 0), max_len=LEAF_SIZE)  # [n_cap, 8]
-    flat = _apply_tail_overrides(flat, n_pages_pad, tail_page, tail_dig,
-                                 has_tail)
+    j8 = jnp.arange(8, dtype=jnp.int32)[None, :]
+    ovr = jnp.where(has_tail[:, None],
+                    j8 * n_pages_pad + tail_page[:, None],
+                    8 * n_pages_pad)  # OOB -> dropped
+    flat = flat.at[ovr.reshape(-1)].set(tail_dig.reshape(-1), mode="drop")
 
     nleaves = jnp.where(live,
                         jnp.maximum((lens_c + LEAF_SIZE - 1) // LEAF_SIZE, 1),
@@ -633,83 +514,6 @@ class FusedSegmentHasher:
             handle, (cand_cap, chunk_cap) = self.dispatch(
                 dev, length, eof=eof, cand_cap=cand_cap,
                 chunk_cap=chunk_cap)
-
-
-class BatchedSegmentHasher:
-    """Host driver for ``chunk_hash_segments``: many independent
-    streams' segments in one dispatch + one fetch (the cross-PVC batch
-    of BASELINE configs[5]).
-
-    ``hash_segments(items)`` takes ``[(bytes-like, valid_len, eof)]``,
-    pads every lane to one shared bucketed length, and returns
-    ``[(chunks, consumed)]`` per lane. Lanes whose true counts overflow
-    the compiled capacities retry INDIVIDUALLY through the
-    single-segment path (adversarial data only — the batch result for
-    the other lanes is already in hand)."""
-
-    def __init__(self, params: GearParams):
-        assert params.align == LEAF_SIZE, \
-            "batched path requires the page-aligned cut format"
-        self.params = params
-        self._single = FusedSegmentHasher(params)
-
-    def hash_segments(self, items) -> list:
-        from volsync_tpu.engine.chunker import _buffer_bucket
-
-        if not items:
-            return []
-        # Lanes GROUP BY buffer bucket: padding every lane to the
-        # largest one would multiply host/HBM bytes by the batch size
-        # when one 32 MiB flush coalesces with tiny eof tails — grouped,
-        # per-lane padded waste is bounded by the bucket rounding (<2x).
-        groups: dict[int, list[int]] = {}
-        for i, (buf, _, _) in enumerate(items):
-            groups.setdefault(_buffer_bucket(max(len(buf), 1)),
-                              []).append(i)
-        out: list = [None] * len(items)
-        for P, idxs in groups.items():
-            for i, res in zip(idxs,
-                              self._hash_bucket(P,
-                                                [items[i] for i in idxs])):
-                out[i] = res
-        return out
-
-    def _hash_bucket(self, P: int, items) -> list:
-        """One dispatch for same-bucket lanes (lane count padded to a
-        pow2 so the jit cache sees a bounded set of (S, P) shapes;
-        padding lanes carry valid_len == 0)."""
-        import jax.numpy as jnp
-
-        p = self.params
-        cand_cap, chunk_cap = segment_caps(P, p)
-        S = _pow2ceil(len(items), 1)
-        rows = np.zeros((S, P), dtype=np.uint8)
-        lens = np.zeros((S,), dtype=np.int32)
-        eofs = np.zeros((S,), dtype=bool)
-        for i, (buf, n, eof) in enumerate(items):
-            arr = np.frombuffer(buf, dtype=np.uint8, count=len(buf))
-            rows[i, : arr.shape[0]] = arr
-            lens[i] = n
-            eofs[i] = eof
-        packed = np.asarray(chunk_hash_segments(
-            jnp.asarray(rows), jnp.asarray(lens), jnp.asarray(eofs),
-            min_size=p.min_size, avg_size=p.avg_size, max_size=p.max_size,
-            seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l, align=p.align,
-            cand_cap=cand_cap, chunk_cap=chunk_cap))
-        out = []
-        for i, (buf, n, eof) in enumerate(items):
-            chunks, consumed, grown = decode_with_overflow_check(
-                packed[i], int(lens[i]), cand_cap, chunk_cap)
-            if grown is not None:
-                # adversarial lane: retry alone with doubled capacities
-                dev = jnp.asarray(rows[i])
-                inflight = self._single.dispatch(
-                    dev, int(lens[i]), eof=bool(eofs[i]),
-                    cand_cap=grown[0], chunk_cap=grown[1])
-                chunks, consumed = self._single.finish(
-                    dev, int(lens[i]), inflight, eof=bool(eofs[i]))
-            out.append((chunks, consumed))
-        return out
 
 
 def decode_with_overflow_check(packed: np.ndarray, length: int,

@@ -1,0 +1,520 @@
+"""Mesh-sharded CDC chunk+hash: the multi-chip product path.
+
+``MeshChunkHasher`` is a drop-in for ``engine.chunker.DeviceChunkHasher``
+(same ``process(buffer, eof)`` protocol), so ``stream_chunks`` /
+``TreeBackup`` — the real backup path — run sharded over a device mesh
+with no orchestration changes. The reference has *no* intra-volume
+parallelism at all (SURVEY.md §5 long-context note: rsync/restic stream
+single-threaded); sharding one volume's scan across chips is the TPU
+framework's core win.
+
+Per segment, two shard_map kernels over a 1-D ``seq`` ring of devices:
+
+1. **Candidates** — each shard gear-hashes its slice with a 31-byte left
+   halo from its neighbor (``ppermute``; the same seam pattern ring
+   attention uses), masks strict/lax CDC candidates, and compacts them to
+   per-shard index lists. Shard 0 zeroes its halo contribution so
+   positions hash exactly as the unsharded recurrence started from h=0.
+2. **Leaf digests** — after the host's sparse FastCDC boundary walk
+   (identical to the single-chip walk, so boundaries are bit-identical),
+   every 4 KiB Merkle leaf of every chunk is assigned to the shard its
+   start falls in; each shard takes a 4095-byte *right* halo so leaves
+   crossing the seam read their tail from the neighbor, and hashes its
+   leaves as independent gather lanes (ops/sha256.sha256_chunks_device).
+
+Blob ids then assemble host-side from the leaf digests (repo/blobid.py),
+byte-identical to the single-device path — golden tests enforce equality
+against both DeviceChunkHasher and hashlib.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from volsync_tpu.engine.chunker import _pow2ceil
+from volsync_tpu.ops.gearcdc import GearParams, _mix_u32, select_boundaries
+from volsync_tpu.repo import blobid
+
+_HALO = 31              # gear window context (see parallel/engine.py)
+_LEAF = blobid.LEAF_SIZE
+SEQ = "seq"
+
+
+def make_stream_mesh(devices=None):
+    """All devices as one ``seq`` ring — a single volume's byte stream
+    shards across every chip (the wave axis of parallel/mesh.py batches
+    *independent* streams; one big backup wants the whole machine)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (SEQ,))
+
+
+class MeshChunkHasher:
+    """chunk+hash a byte buffer sharded over a device mesh.
+
+    Compile-count discipline matches DeviceChunkHasher: shard lengths are
+    drawn from pow2 buckets, candidate/leaf capacities from doubling
+    buckets, so steady-state streaming reuses a handful of compiled
+    programs regardless of workload shape.
+    """
+
+    #: NOT safe for concurrent process() calls: sharded dispatches issue
+    #: mesh collectives whose per-device enqueue order must match across
+    #: the ring, and the compiled-fn caches race. TreeBackup serializes
+    #: file hashing when this hasher is injected.
+    thread_safe = False
+
+    def __init__(self, params: GearParams, mesh=None):
+        import jax
+
+        self.params = params
+        self.mesh = mesh if mesh is not None else make_stream_mesh()
+        self.n_shards = self.mesh.devices.size
+        self._cand_cache: dict = {}
+        self._leaf_cache: dict = {}
+        self._fused_cache: dict = {}
+        self._jax = jax
+
+    # -- public protocol (mirrors DeviceChunkHasher.process) ----------------
+
+    def process(self, buffer, *, eof: bool = True) -> list[tuple[int, int, str]]:
+        if isinstance(buffer, (bytes, bytearray, memoryview)):
+            buffer = np.frombuffer(buffer, dtype=np.uint8)
+        length = int(buffer.shape[0])
+        if length == 0:
+            return []
+        p = self.params
+        if length <= p.min_size:
+            if not eof:
+                return []
+            return [(0, length, blobid.blob_id(buffer.tobytes()))]
+
+        data, shard_len = self._upload(buffer, length)
+        if p.align == _LEAF:
+            return self._process_fused(data, shard_len, length, eof)
+        idx_s, idx_l = self._candidates(data, shard_len, length)
+        chunks = select_boundaries(idx_s, idx_l, length, p, eof=eof)
+        if not chunks:
+            return []
+        hexes = self._span_roots(data, shard_len, chunks)
+        return [(int(s), int(l), h) for (s, l), h in zip(chunks, hexes)]
+
+    # -- fused page-aligned path (one dispatch, one small fetch) ------------
+
+    def _process_fused(self, data, shard_len: int, length: int,
+                       eof: bool) -> list[tuple[int, int, str]]:
+        """The ops/segment.py one-round-trip protocol, sharded: page
+        digests and candidates compute per shard (pages never cross
+        seams — shard_len % LEAF == 0 — so there is NO halo at all),
+        the 32-bytes-per-4KiB digest stream all-gathers over the seq
+        ring (1/128th of the data volume, riding ICI), and the FastCDC
+        walk + root assembly run replicated on the gathered table. ONE
+        replicated ~20 KiB result comes back; capacity overflows are
+        reported in-band and retried with doubled tables, exactly like
+        the single-chip FusedSegmentHasher."""
+        from volsync_tpu.ops.segment import (
+            decode_with_overflow_check,
+            segment_caps,
+        )
+
+        padded = self.n_shards * shard_len
+        cand_cap, chunk_cap = segment_caps(padded, self.params)
+        # cand_cap is per shard in this path (compaction is local; the
+        # header's candidate slot carries the WORST shard's true count).
+        cand_cap = max(1024, cand_cap // self.n_shards)
+        while True:
+            fn = self._fused_fn(shard_len, cand_cap, chunk_cap, eof)
+            packed = np.asarray(fn(data, np.int32(length)))
+            chunks, consumed, grown = decode_with_overflow_check(
+                packed, length, cand_cap, chunk_cap)
+            if grown is None:
+                assert not eof or consumed == length
+                return chunks
+            cand_cap, chunk_cap = grown
+
+    def _fused_fn(self, shard_len: int, cand_cap: int, chunk_cap: int,
+                  eof: bool):
+        key = (shard_len, cand_cap, chunk_cap, eof)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = _build_fused_fn(self.mesh, self.params, shard_len,
+                                 cand_cap, chunk_cap, eof)
+            self._fused_cache[key] = fn
+        return fn
+
+    # -- upload -------------------------------------------------------------
+
+    def _upload(self, buffer: np.ndarray, length: int):
+        """Pad to S * pow2-bucketed shard length, lay out [S, Ls] with
+        shard i holding bytes [i*Ls, (i+1)*Ls)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        S = self.n_shards
+        shard_len = _pow2ceil((length + S - 1) // S, max(_LEAF, 64 * 1024))
+        padded = S * shard_len
+        if padded != length:
+            buffer = np.pad(buffer, (0, padded - length))
+        host = buffer.reshape(S, shard_len)
+        data = jax.device_put(
+            host, NamedSharding(self.mesh, P(SEQ, None)))
+        return data, shard_len
+
+    # -- kernel 1: CDC candidates -------------------------------------------
+
+    def _cand_fn(self, key):
+        fn = self._cand_cache.get(key)
+        if fn is None:
+            if isinstance(key, tuple) and key[0] == "aligned":
+                fn = _build_cand_aligned_fn(self.mesh, self.params,
+                                            key[1], key[2])
+            else:
+                fn = _build_cand_fn(self.mesh, self.params, *key)
+            self._cand_cache[key] = fn
+        return fn
+
+    def _candidates(self, data, shard_len: int, length: int):
+        if self.params.align > 1:
+            return self._candidates_aligned(data, shard_len, length)
+        # Expected strict-candidate density is 2^-(bits+norm); 1/64 bytes
+        # covers any mask down to 2^-6 (same bound as DeviceChunkHasher).
+        cap = max(_pow2ceil(shard_len // 64, 1024), 1024)
+        while True:
+            idx_s, cnt_s, idx_l, cnt_l = self._cand_fn((shard_len, cap))(
+                data, np.int32(length))
+            cnt_s = np.asarray(cnt_s)
+            cnt_l = np.asarray(cnt_l)
+            worst = int(max(cnt_s.max(), cnt_l.max()))
+            if worst <= cap:
+                break
+            cap = _pow2ceil(worst, cap * 2)  # dense data: retry, recompile
+        idx_s = np.asarray(idx_s)
+        idx_l = np.asarray(idx_l)
+        # Per-shard compacted lists -> one globally sorted list (shards
+        # are contiguous byte ranges in order, so concatenation sorts).
+        out_s = np.concatenate([idx_s[i, : int(cnt_s[i])]
+                                for i in range(self.n_shards)])
+        out_l = np.concatenate([idx_l[i, : int(cnt_l[i])]
+                                for i in range(self.n_shards)])
+        return out_s, out_l
+
+    def _candidates_aligned(self, data, shard_len: int, length: int):
+        """Aligned cuts need NO halo: the gear window at an eligible
+        position sits inside one align-byte row, which never crosses a
+        shard seam (shard_len % align == 0) — the collective disappears
+        and each shard compacts its own row lanes."""
+        cap = 1024
+        while True:
+            pos, flags, cnt = self._cand_fn(("aligned", shard_len, cap))(
+                data, np.int32(length))
+            cnt = np.asarray(cnt)
+            worst = int(cnt.max())
+            if worst <= cap:
+                break
+            cap = _pow2ceil(worst, cap * 2)
+        pos = np.asarray(pos)
+        flags = np.asarray(flags)
+        out_l = []
+        out_s = []
+        for i in range(self.n_shards):
+            n = int(cnt[i])
+            p = pos[i, :n]
+            out_l.append(p)
+            out_s.append(p[flags[i, :n]])
+        return np.concatenate(out_s), np.concatenate(out_l)
+
+    # -- kernel 2: Merkle leaf digests --------------------------------------
+
+    def _leaf_fn(self, shard_len: int, cap: int):
+        key = (shard_len, cap)
+        fn = self._leaf_cache.get(key)
+        if fn is None:
+            fn = _build_leaf_fn(self.mesh, shard_len, cap)
+            self._leaf_cache[key] = fn
+        return fn
+
+    def _span_roots(self, data, shard_len: int,
+                    chunks: list[tuple[int, int]]) -> list[str]:
+        S = self.n_shards
+        # Assign every leaf to the shard its start falls in; record
+        # (shard, slot) per leaf for reassembly.
+        per_shard: list[list[tuple[int, int]]] = [[] for _ in range(S)]
+        placement: list[tuple[int, int]] = []  # leaf -> (shard, slot)
+        spans: list[tuple[int, int]] = []      # chunk -> (first leaf, count)
+        for start, clen in chunks:
+            first = len(placement)
+            n = blobid.leaf_count(clen)
+            for k in range(n):
+                off = start + k * _LEAF
+                llen = min(_LEAF, start + clen - off)
+                shard = off // shard_len
+                slot = len(per_shard[shard])
+                per_shard[shard].append((off - shard * shard_len, llen))
+                placement.append((shard, slot))
+            spans.append((first, n))
+
+        cap = _pow2ceil(max((len(v) for v in per_shard), default=1),
+                        max(shard_len // _LEAF // 8, 128))
+        starts = np.zeros((S, cap), np.int32)
+        lengths = np.zeros((S, cap), np.int32)
+        for s in range(S):
+            for slot, (off, llen) in enumerate(per_shard[s]):
+                starts[s, slot] = off
+                lengths[s, slot] = llen
+        digests = np.asarray(
+            self._leaf_fn(shard_len, cap)(data, starts, lengths)
+        ).astype(">u4")  # [S, cap, 8] big-endian
+        flat = digests.tobytes()
+
+        def leaf_bytes(shard: int, slot: int) -> bytes:
+            base = (shard * cap + slot) * 32
+            return flat[base: base + 32]
+
+        out = []
+        for (first, n), (_, clen) in zip(spans, chunks):
+            leaves = [leaf_bytes(*placement[first + k]) for k in range(n)]
+            out.append(blobid.root_from_leaves(clen, leaves))
+        return out
+
+
+def _build_fused_fn(mesh, params: GearParams, shard_len: int,
+                    cand_cap: int, chunk_cap: int, eof: bool):
+    """shard_map kernel for the fused page-aligned segment protocol.
+
+    Layout: data [S, Ls] with shard i holding bytes [i*Ls, (i+1)*Ls);
+    Ls % LEAF == 0, so pages (== full Merkle leaves, align == LEAF)
+    never cross seams and per-shard page hashing needs no collective.
+    Per shard: page digests (ops/segment._page_digests_flat — the
+    Pallas transpose + SHA lane kernel on TPU, the XLA scan on CPU) and
+    aligned gear candidates. Then: all_gather of the digest words and
+    the compacted candidate lists (sentinel-padded, re-sorted), psum'd
+    counts, and the ops/segment walk + root loop on the replicated
+    tables — every shard computes the identical ~20 KiB packed result.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.ops.gearcdc import gear_at_aligned
+    from volsync_tpu.ops.segment import (
+        _page_digests_flat,
+        _root_digests_loop,
+        _select_boundaries_device,
+    )
+    from volsync_tpu.ops.sha256 import (
+        _LANE_TILE,
+        sha256_chunks_device,
+        use_pallas_leaves,
+    )
+
+    p = params
+    S = mesh.devices.size
+    align = p.align
+    npp = shard_len // _LEAF  # real pages per shard
+    npps = ((npp + _LANE_TILE - 1) // _LANE_TILE * _LANE_TILE
+            if use_pallas_leaves() else npp)  # padded (Pallas lane grid)
+    R = shard_len // align
+    mask_s = np.uint32(p.mask_s)
+    mask_l = np.uint32(p.mask_l)
+    sentinel = jnp.int32(2**31 - 2)
+
+    def local(data, valid_len):  # data: [1, Ls]
+        i = jax.lax.axis_index(SEQ)
+        row = data[0]
+        valid_len = valid_len.astype(jnp.int32)
+
+        # --- per-shard page digests (no halo: pages don't cross seams)
+        flat_local = _page_digests_flat(row, npps)  # [8 * npps]
+        flat_g = jax.lax.all_gather(flat_local, SEQ, axis=0)  # [S, 8*npps]
+        flat_g = flat_g.reshape(S * 8 * npps)
+
+        def word_index(j, page):  # word j of GLOBAL page p
+            return (page // npp) * (8 * npps) + j * npps + page % npp
+
+        # --- per-shard aligned candidates -> global sorted tables
+        h = gear_at_aligned(row, p.seed, align)  # [R]
+        pos = (i * shard_len
+               + jnp.arange(R, dtype=jnp.int32) * align + (align - 1))
+        ok = pos < valid_len
+        is_s = ((h & mask_s) == 0) & ok
+        is_l = ((h & mask_l) == 0) & ok
+        ridx_l = jnp.nonzero(is_l, size=cand_cap, fill_value=R)[0]
+        safe = jnp.clip(ridx_l, 0, R - 1)
+        lpos = jnp.where(ridx_l < R, pos[safe], sentinel)
+        lstrict = jnp.where(ridx_l < R, is_s[safe], False)
+        spos = jnp.where(lstrict, lpos, sentinel)
+        pos_l = jnp.sort(jax.lax.all_gather(lpos, SEQ, axis=0).reshape(-1))
+        pos_s = jnp.sort(jax.lax.all_gather(spos, SEQ, axis=0).reshape(-1))
+        nl = jax.lax.psum(jnp.sum(is_l).astype(jnp.int32), SEQ)
+        ns = jax.lax.psum(jnp.sum(is_s).astype(jnp.int32), SEQ)
+        worst = jax.lax.pmax(jnp.sum(is_l).astype(jnp.int32), SEQ)
+
+        # --- replicated FastCDC walk
+        starts, lens, count, consumed = _select_boundaries_device(
+            pos_s, jnp.minimum(ns, S * cand_cap),
+            pos_l, jnp.minimum(nl, S * cand_cap),
+            valid_len, min_size=p.min_size, avg_size=p.avg_size,
+            max_size=p.max_size, chunk_cap=chunk_cap, eof=eof)
+
+        # --- the ONE possibly-partial tail leaf: hashed by its owner
+        # shard, psum-broadcast, spliced into the gathered table.
+        live = jnp.arange(chunk_cap, dtype=jnp.int32) < count
+        end = jnp.where(count > 0,
+                        starts[jnp.maximum(count - 1, 0)]
+                        + lens[jnp.maximum(count - 1, 0)], 0)
+        has_tail = (count > 0) & (end % _LEAF != 0)
+        tail_page = jnp.maximum(end - 1, 0) // _LEAF
+        tail_len = end - tail_page * _LEAF
+        owner = tail_page // npp
+        loc_off = (tail_page % npp) * _LEAF
+        mine = has_tail & (owner == i)
+        t_dig = sha256_chunks_device(
+            row, loc_off[None], jnp.where(mine, tail_len, 0)[None],
+            max_len=_LEAF)[0]
+        t_dig = jax.lax.psum(
+            jnp.where(mine, t_dig, jnp.uint32(0)), SEQ)
+        ovr = jnp.where(has_tail,
+                        word_index(jnp.arange(8, dtype=jnp.int32),
+                                   tail_page),
+                        S * 8 * npps)  # OOB -> dropped
+        flat_g = flat_g.at[ovr].set(t_dig, mode="drop")
+
+        # --- replicated roots + packed result
+        nleaves = jnp.where(live, (lens + (_LEAF - 1)) // _LEAF, 0)
+        page0 = starts // _LEAF
+        roots = _root_digests_loop(flat_g, S * npp, page0, nleaves, lens,
+                                   live, word_index=word_index)
+        header = jnp.stack([count.astype(jnp.uint32),
+                            consumed.astype(jnp.uint32),
+                            worst.astype(jnp.uint32),
+                            jnp.sum(nleaves).astype(jnp.uint32)])
+        return jnp.concatenate([header, starts.astype(jnp.uint32),
+                                lens.astype(jnp.uint32), roots.reshape(-1)])
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def _build_cand_fn(mesh, params: GearParams, shard_len: int, cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.parallel.engine import _gear_doubling
+
+    seed = np.uint32(params.seed & 0xFFFFFFFF)
+    mask_s = np.uint32(params.mask_s)
+    mask_l = np.uint32(params.mask_l)
+
+    def local(data, valid_len):  # data: [1, Ls] this shard's slice
+        n = jax.lax.axis_size(SEQ)
+        i = jax.lax.axis_index(SEQ)
+        row = data[0]
+        # Left halo: previous shard's 31-byte tail, shifted right around
+        # the ring; shard 0 (true stream start) contributes zero table
+        # values for its halo positions, reproducing the unsharded
+        # recurrence's h=0 start (see parallel/engine.py local_step).
+        halo = jax.lax.ppermute(
+            row[-_HALO:], SEQ, [(j, (j + 1) % n) for j in range(n)])
+        ext = jnp.concatenate([halo, row])
+        g = _mix_u32(ext.astype(jnp.uint32) + seed)
+        g = jnp.where((i == 0) & (jnp.arange(ext.shape[0]) < _HALO),
+                      jnp.uint32(0), g)
+        h = _gear_doubling(g)[_HALO:]  # [Ls]
+        pos = i * shard_len + jnp.arange(shard_len, dtype=jnp.int32)
+        ok = pos < valid_len
+        is_s = ((h & mask_s) == 0) & ok
+        is_l = ((h & mask_l) == 0) & ok
+        loc_s = jnp.nonzero(is_s, size=cap, fill_value=shard_len)[0]
+        loc_l = jnp.nonzero(is_l, size=cap, fill_value=shard_len)[0]
+        # Global positions; fill lanes fall off the end harmlessly (the
+        # host slices each shard's list by its true count).
+        return ((i * shard_len + loc_s)[None],
+                jnp.sum(is_s)[None],
+                (i * shard_len + loc_l)[None],
+                jnp.sum(is_l)[None])
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P()),
+        out_specs=(P(SEQ, None), P(SEQ), P(SEQ, None), P(SEQ)),
+    )
+    return jax.jit(sharded)
+
+
+def _build_cand_aligned_fn(mesh, params: GearParams, shard_len: int,
+                           cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.ops.gearcdc import gear_at_aligned
+
+    align = params.align
+    mask_s = np.uint32(params.mask_s)
+    mask_l = np.uint32(params.mask_l)
+    R = shard_len // align
+
+    def local(data, valid_len):  # data: [1, Ls]
+        i = jax.lax.axis_index(SEQ)
+        h = gear_at_aligned(data[0], params.seed, align)  # [R], no halo
+        pos = (i * shard_len
+               + jnp.arange(R, dtype=jnp.int32) * align + (align - 1))
+        ok = pos < valid_len
+        is_s = ((h & mask_s) == 0) & ok
+        is_l = ((h & mask_l) == 0) & ok
+        ridx = jnp.nonzero(is_l, size=cap, fill_value=R)[0]
+        safe = jnp.clip(ridx, 0, R - 1)
+        flags = jnp.where(ridx < R, is_s[safe], False)
+        out_pos = (i * shard_len + ridx.astype(jnp.int32) * align
+                   + (align - 1))
+        return out_pos[None], flags[None], jnp.sum(is_l)[None]
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P()),
+        out_specs=(P(SEQ, None), P(SEQ, None), P(SEQ)),
+    )
+    return jax.jit(sharded)
+
+
+def _build_leaf_fn(mesh, shard_len: int, cap: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from volsync_tpu.ops.sha256 import sha256_chunks_device
+
+    assert shard_len >= _LEAF, "shards must cover at least one leaf"
+
+    def local(data, starts, lengths):  # [1, Ls], [1, cap], [1, cap]
+        n = jax.lax.axis_size(SEQ)
+        row = data[0]
+        # Right halo: my leaves may run up to LEAF-1 bytes past my slice;
+        # fetch the next shard's head (ring: the last shard's wrap-around
+        # halo is never referenced — the stream ends inside it).
+        halo = jax.lax.ppermute(
+            row[: _LEAF - 1], SEQ, [(j, (j - 1) % n) for j in range(n)])
+        ext = jnp.concatenate([row, halo])
+        digests = sha256_chunks_device(
+            ext, starts[0], lengths[0], max_len=_LEAF)
+        return digests[None]  # [1, cap, 8]
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SEQ, None), P(SEQ, None), P(SEQ, None)),
+        out_specs=P(SEQ, None, None),
+    )
+    return jax.jit(sharded)

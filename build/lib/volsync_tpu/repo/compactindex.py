@@ -1,0 +1,284 @@
+"""Compact in-memory blob index: bounded RAM at million-blob scale.
+
+A 1 TiB repository at ~1 MiB average chunk size carries ~1M blobs. The
+obvious ``dict[str, IndexEntry]`` costs ~500 bytes per blob (hex-string
+key + dataclass + dict slot) — half a gigabyte of pure bookkeeping, and
+the engine the reference wraps streams the same repository with O(1)
+memory (reference: mover-restic/entry.sh:77 drives `restic` whose
+in-memory index packs blob records into flat tables for exactly this
+reason). This is the equivalent flat layout: parallel numpy arrays (32
+raw key bytes + pack#/type/offset/length/raw_length ≈ 53 bytes per
+entry) behind an open-addressed int32 slot table, with pack ids interned
+once. ~10x less RAM than the dict, no per-entry Python objects, and a
+``copy()`` that is three array copies instead of a million allocations.
+
+Deletions (prune) leave tombstones in the slot table and a dead mark in
+the entry arrays; ``vacuum()`` rebuilds both dense. The table rebuilds
+automatically when live+tombstone load crosses ~2/3.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+_EMPTY = -1
+_TOMB = -2
+_DEAD_PACK = np.uint32(0xFFFFFFFF)
+
+
+class CompactIndex:
+    """Mapping-like store: 64-char hex blob id -> entry tuple.
+
+    Values go in/out as ``(pack_id: str, type: str, offset: int,
+    length: int, raw_length: int)``; the Repository wraps them in its
+    IndexEntry dataclass at the boundary. Not thread-safe — callers hold
+    the repository lock, as they did for the dict this replaces.
+    """
+
+    __slots__ = ("_keys", "_pack", "_type", "_off", "_len", "_raw",
+                 "_n", "_live", "_table", "_mask", "_tombs",
+                 "_packs", "_pack_idx", "_types", "_type_idx")
+
+    def __init__(self, capacity: int = 1024):
+        cap = max(16, capacity)
+        self._keys = np.zeros((cap, 4), dtype=np.uint64)
+        self._pack = np.zeros((cap,), dtype=np.uint32)
+        self._type = np.zeros((cap,), dtype=np.uint8)
+        self._off = np.zeros((cap,), dtype=np.uint64)
+        self._len = np.zeros((cap,), dtype=np.uint32)
+        self._raw = np.zeros((cap,), dtype=np.uint32)
+        self._n = 0          # entry rows used (incl. dead)
+        self._live = 0       # live entries
+        ts = 1
+        while ts < cap * 2:
+            ts *= 2
+        self._table = np.full((ts,), _EMPTY, dtype=np.int64)
+        self._mask = ts - 1
+        self._tombs = 0
+        self._packs: list[str] = []
+        self._pack_idx: dict[str, int] = {}
+        self._types: list[str] = []
+        self._type_idx: dict[str, int] = {}
+
+    # -- key codec ----------------------------------------------------------
+
+    @staticmethod
+    def _key4(hex_id: str) -> tuple[int, int, int, int]:
+        b = bytes.fromhex(hex_id)
+        if len(b) != 32:
+            raise ValueError(f"blob id must be 32 bytes hex: {hex_id!r}")
+        return (int.from_bytes(b[0:8], "big"), int.from_bytes(b[8:16], "big"),
+                int.from_bytes(b[16:24], "big"),
+                int.from_bytes(b[24:32], "big"))
+
+    @staticmethod
+    def _hex(row: np.ndarray) -> str:
+        return b"".join(int(w).to_bytes(8, "big") for w in row).hex()
+
+    # -- internals ----------------------------------------------------------
+
+    def _intern(self, value: str, values: list, index: dict) -> int:
+        i = index.get(value)
+        if i is None:
+            i = len(values)
+            values.append(value)
+            index[value] = i
+        return i
+
+    def _probe(self, k4) -> tuple[int, int]:
+        """-> (slot, entry_row) with entry_row == -1 when absent; slot is
+        the insertion point (first tombstone seen, else the empty)."""
+        table = self._table
+        keys = self._keys
+        mask = self._mask
+        i = k4[0] & mask
+        first_tomb = -1
+        while True:
+            j = table[i]
+            if j == _EMPTY:
+                return (first_tomb if first_tomb >= 0 else i), -1
+            if j == _TOMB:
+                if first_tomb < 0:
+                    first_tomb = i
+            else:
+                row = keys[j]
+                if (row[0] == k4[0] and row[1] == k4[1]
+                        and row[2] == k4[2] and row[3] == k4[3]):
+                    return i, int(j)
+            i = (i + 1) & mask
+
+    def _grow_entries(self):
+        cap = self._keys.shape[0] * 2
+        for name in ("_keys", "_pack", "_type", "_off", "_len", "_raw"):
+            old = getattr(self, name)
+            shape = (cap,) + old.shape[1:]
+            new = np.zeros(shape, dtype=old.dtype)
+            new[: self._n] = old[: self._n]
+            setattr(self, name, new)
+
+    def _rebuild_table(self, min_size: Optional[int] = None):
+        ts = self._table.shape[0]
+        want = max(min_size or 0, self._live * 3)
+        while ts < want:
+            ts *= 2
+        mask = ts - 1
+        # Hot at million-entry scale: plain-list probing (~100ns/entry)
+        # instead of numpy scalar indexing (~2us/entry); one bulk
+        # conversion at each end.
+        table = [_EMPTY] * ts
+        rows = np.nonzero(self._pack[: self._n] != _DEAD_PACK)[0]
+        slots = (self._keys[rows, 0] & np.uint64(mask)).astype(np.int64)
+        for j, i in zip(rows.tolist(), slots.tolist()):
+            while table[i] != _EMPTY:
+                i = (i + 1) & mask
+            table[i] = j
+        self._table = np.asarray(table, dtype=np.int64)
+        self._mask = mask
+        self._tombs = 0
+
+    # -- mapping API --------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._live
+
+    def __contains__(self, hex_id: str) -> bool:
+        return self._probe(self._key4(hex_id))[1] >= 0
+
+    def lookup(self, hex_id: str):
+        """-> (pack, type, offset, length, raw_length) or None."""
+        _, j = self._probe(self._key4(hex_id))
+        if j < 0:
+            return None
+        return (self._packs[self._pack[j]], self._types[self._type[j]],
+                int(self._off[j]), int(self._len[j]), int(self._raw[j]))
+
+    def insert(self, hex_id: str, pack: str, btype: str, offset: int,
+               length: int, raw_length: int, *, replace: bool = True) -> bool:
+        """Insert/overwrite. With replace=False an existing entry is kept
+        (dict.setdefault). Returns True if the mapping changed."""
+        if length >= 2**32 or raw_length >= 2**32:
+            raise ValueError("blob larger than 4 GiB cannot be indexed")
+        k4 = self._key4(hex_id)
+        slot, j = self._probe(k4)
+        if j >= 0:
+            if not replace:
+                return False
+            self._pack[j] = self._intern(pack, self._packs, self._pack_idx)
+            self._type[j] = self._intern(btype, self._types, self._type_idx)
+            self._off[j] = offset
+            self._len[j] = length
+            self._raw[j] = raw_length
+            return True
+        if self._n == self._keys.shape[0]:
+            self._grow_entries()
+        j = self._n
+        self._keys[j] = k4
+        self._pack[j] = self._intern(pack, self._packs, self._pack_idx)
+        self._type[j] = self._intern(btype, self._types, self._type_idx)
+        self._off[j] = offset
+        self._len[j] = length
+        self._raw[j] = raw_length
+        self._n += 1
+        self._live += 1
+        if self._table[slot] == _TOMB:
+            self._tombs -= 1
+        self._table[slot] = j
+        if (self._live + self._tombs) * 3 > self._table.shape[0] * 2:
+            self._rebuild_table()
+        return True
+
+    def remove(self, hex_id: str) -> bool:
+        slot, j = self._probe(self._key4(hex_id))
+        if j < 0:
+            return False
+        self._table[slot] = _TOMB
+        self._tombs += 1
+        self._pack[j] = _DEAD_PACK
+        self._live -= 1
+        return True
+
+    def clear(self):
+        self.__init__(capacity=16)
+
+    def items(self) -> Iterator[tuple[str, tuple]]:
+        """Yield (hex_id, (pack, type, offset, length, raw_length)) for
+        every live entry. Snapshot the arrays first so callers may mutate
+        while iterating a copy()."""
+        packs = self._packs
+        types = self._types
+        for j in range(self._n):
+            p = self._pack[j]
+            if p == _DEAD_PACK:
+                continue
+            yield (self._hex(self._keys[j]),
+                   (packs[p], types[self._type[j]], int(self._off[j]),
+                    int(self._len[j]), int(self._raw[j])))
+
+    def keys(self) -> Iterator[str]:
+        for j in range(self._n):
+            if self._pack[j] != _DEAD_PACK:
+                yield self._hex(self._keys[j])
+
+    __iter__ = keys
+
+    def copy(self) -> "CompactIndex":
+        new = CompactIndex.__new__(CompactIndex)
+        for name in ("_keys", "_pack", "_type", "_off", "_len", "_raw",
+                     "_table"):
+            setattr(new, name, getattr(self, name).copy())
+        new._n = self._n
+        new._live = self._live
+        new._mask = self._mask
+        new._tombs = self._tombs
+        new._packs = list(self._packs)
+        new._pack_idx = dict(self._pack_idx)
+        new._types = list(self._types)
+        new._type_idx = dict(self._type_idx)
+        return new
+
+    def vacuum(self):
+        """Drop dead rows + retired pack ids; rebuild dense. Call after a
+        prune that removed many entries."""
+        keep = np.nonzero(self._pack[: self._n] != _DEAD_PACK)[0]
+        live_packs = sorted({int(p) for p in self._pack[keep]})
+        remap = np.zeros((len(self._packs) or 1,), dtype=np.uint32)
+        new_packs: list[str] = []
+        for p in live_packs:
+            remap[p] = len(new_packs)
+            new_packs.append(self._packs[p])
+        self._keys = self._keys[keep].copy()
+        self._pack = remap[self._pack[keep]].copy()
+        self._type = self._type[keep].copy()
+        self._off = self._off[keep].copy()
+        self._len = self._len[keep].copy()
+        self._raw = self._raw[keep].copy()
+        self._n = self._live = int(keep.shape[0])
+        self._packs = new_packs
+        self._pack_idx = {p: i for i, p in enumerate(new_packs)}
+        self._rebuild_table()
+
+    def snapshot_arrays(self) -> tuple[np.ndarray, np.ndarray, list]:
+        """(keys, pack_codes, pack_names) for live entries in entry
+        order: keys is an (N,) ``S32`` array of 32-byte big-endian blob
+        ids, pack_codes indexes pack_names. The vectorized view prune
+        uses for whole-index liveness math without touching per-entry
+        Python objects."""
+        rows = np.nonzero(self._pack[: self._n] != _DEAD_PACK)[0]
+        kb = self._keys[rows].astype(">u8").tobytes()
+        keys = np.frombuffer(kb, dtype="S32")
+        return keys, self._pack[rows].copy(), list(self._packs)
+
+    def live_packs(self) -> set[str]:
+        """Distinct pack ids referenced by live entries — one vectorized
+        pass over the pack column, no per-entry id decoding."""
+        rows = self._pack[: self._n]
+        used = np.unique(rows[rows != _DEAD_PACK])
+        return {self._packs[int(p)] for p in used}
+
+    def nbytes(self) -> int:
+        """Approximate resident bytes of the index structures."""
+        return sum(getattr(self, a).nbytes
+                   for a in ("_keys", "_pack", "_type", "_off", "_len",
+                             "_raw", "_table"))

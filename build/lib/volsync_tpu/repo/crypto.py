@@ -1,0 +1,84 @@
+"""Repository encryption: AES-256-CTR + HMAC-SHA256, scrypt KDF.
+
+The reference's restic engine encrypts every blob/pack/index with
+AES-256-CTR and authenticates with Poly1305-AES (SURVEY.md §2.2 #25).
+This clean-room equivalent keeps the same *security envelope* —
+per-object random nonce, encrypt-then-MAC, password-derived master key —
+using the primitives available in this image's ``cryptography`` wheel
+(HMAC-SHA256 instead of Poly1305; scrypt for key derivation, as restic).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+from typing import Optional
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+_NONCE = 16  # AES block / CTR nonce size
+_MAC = 32    # HMAC-SHA256
+
+
+class IntegrityError(ValueError):
+    pass
+
+
+class WrongPassword(ValueError):
+    pass
+
+
+class SecretBox:
+    """seal/open with key separation: one AES key, one MAC key."""
+
+    def __init__(self, enc_key: bytes, mac_key: bytes):
+        assert len(enc_key) == 32 and len(mac_key) == 32
+        self.enc_key = enc_key
+        self.mac_key = mac_key
+
+    def seal(self, plaintext: bytes) -> bytes:
+        nonce = os.urandom(_NONCE)
+        enc = Cipher(algorithms.AES(self.enc_key), modes.CTR(nonce)).encryptor()
+        ct = enc.update(plaintext) + enc.finalize()
+        mac = hmac_mod.new(self.mac_key, nonce + ct, hashlib.sha256).digest()
+        return nonce + ct + mac
+
+    def open(self, sealed: bytes) -> bytes:
+        if len(sealed) < _NONCE + _MAC:
+            raise IntegrityError("sealed object too short")
+        nonce, ct, mac = (sealed[:_NONCE], sealed[_NONCE:-_MAC],
+                          sealed[-_MAC:])
+        want = hmac_mod.new(self.mac_key, nonce + ct, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(mac, want):
+            raise IntegrityError("MAC mismatch (corrupt or tampered object)")
+        dec = Cipher(algorithms.AES(self.enc_key), modes.CTR(nonce)).decryptor()
+        return dec.update(ct) + dec.finalize()
+
+    @property
+    def overhead(self) -> int:
+        return _NONCE + _MAC
+
+
+class PlainBox:
+    """No-op box for unencrypted repositories."""
+
+    def seal(self, plaintext: bytes) -> bytes:
+        return plaintext
+
+    def open(self, sealed: bytes) -> bytes:
+        return sealed
+
+    overhead = 0
+
+
+def derive_keys(password: str, salt: bytes, *, n: int = 2**15, r: int = 8,
+                p: int = 1) -> SecretBox:
+    """scrypt(password) -> 64 bytes -> (enc_key, mac_key)."""
+    km = hashlib.scrypt(password.encode(), salt=salt, n=n, r=r, p=p,
+                        maxmem=256 * 1024 * 1024, dklen=64)
+    return SecretBox(km[:32], km[32:])
+
+
+def make_box(password: Optional[str], salt: bytes):
+    return derive_keys(password, salt) if password else PlainBox()

@@ -1,0 +1,812 @@
+"""Content-addressed deduplicating repository (restic-equivalent semantics).
+
+Clean-room design with the same capability envelope as the engine the
+reference wraps (SURVEY.md §2.2 #25: CDC chunking, per-blob SHA-256 ids,
+AES encryption, pack/index/snapshot objects, retain policy + prune,
+point-in-time restore selection): blobs keyed by the SHA-256 of their
+plaintext, grouped into immutable pack objects; index objects map blob id
+-> (pack, offset); snapshot manifests reference a tree blob. Formats are
+msgpack/json + zstd, sealed by repo/crypto.py when a password is set.
+
+Layout in the object store:
+    config                      repo id, chunker params, KDF salt+verifier
+    data/<p2>/<pack-id>         packs: sealed blob segments + sealed header
+    index/<id>                  sealed, compressed index delta
+    snapshots/<id>              sealed snapshot manifest
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time as time_mod
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta, timezone
+from typing import Iterable, Optional
+
+import zstandard
+
+from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
+from volsync_tpu.repo import blobid, crypto
+from volsync_tpu.repo.compactindex import CompactIndex
+
+BLOB_DATA = "data"
+BLOB_TREE = "tree"
+
+_VERIFIER_PLAINTEXT = b"volsync-tpu repository key verifier v1"
+_COMPRESS_MIN_GAIN = 0.9  # keep compressed form only if <= 90% of raw
+
+#: Default chunker parameters for new repositories — the single source
+#: of truth (Repository.init and the movers' align-override knob both
+#: build from this; see init() for the align rationale).
+DEFAULT_CHUNKER = {"min_size": 512 * 1024,
+                   "avg_size": 1024 * 1024,
+                   "max_size": 8 * 1024 * 1024,
+                   "seed": 0x5EED_CDC1,
+                   "align": 4096}
+
+
+class RepoError(RuntimeError):
+    pass
+
+
+class RepoLockedError(RepoError):
+    """Another process holds a conflicting repository lock."""
+
+
+def _parse_time(value: str) -> datetime:
+    t = datetime.fromisoformat(value)
+    return t.replace(tzinfo=timezone.utc) if t.tzinfo is None else t
+
+
+@dataclass
+class IndexEntry:
+    pack: str
+    type: str
+    offset: int
+    length: int       # stored (sealed) length
+    raw_length: int   # plaintext length
+
+
+@dataclass
+class BackupStats:
+    files: int = 0
+    bytes_scanned: int = 0
+    blobs_new: int = 0
+    bytes_new: int = 0       # plaintext bytes newly stored
+    bytes_stored: int = 0    # stored (compressed+sealed) bytes
+    blobs_dedup: int = 0
+    bytes_dedup: int = 0
+
+    def as_dict(self):
+        return self.__dict__.copy()
+
+
+class Repository:
+    PACK_TARGET = 16 * 1024 * 1024
+    #: Pending (not yet persisted) index entries buffered before an index
+    #: delta is written mid-run. Bounds _pending_index RAM on huge
+    #: backups: without it a 1 TiB first backup would hold ~1M entry
+    #: dicts until the final flush().
+    PENDING_INDEX_LIMIT = 32768
+
+    def __init__(self, store: ObjectStore, box, config: dict):
+        self.store = store
+        self.box = box
+        self.config = config
+        # Compact flat-array index (repo/compactindex.py): ~10x less RAM
+        # than dict[str, IndexEntry] at million-blob scale — the envelope
+        # is ~60 bytes/blob, so a 1 TiB repo (~1M blobs at the default
+        # ~1 MiB target) indexes in ~60 MB.
+        self._index = CompactIndex()
+        self._lock = threading.RLock()
+        self._cur_segments: list[bytes] = []
+        self._cur_entries: list[dict] = []
+        self._cur_size = 0
+        self._pending_index: dict[str, list[dict]] = {}
+        self._pending_count = 0
+        self._zc = zstandard.ZstdCompressor(level=3)
+        # Decompression runs OUTSIDE self._lock on the concurrent
+        # restore/verify paths (read_blob from worker pools), and a
+        # ZstdDecompressor shares one ZSTD_DCtx that python-zstandard
+        # documents as not thread-safe — so it's thread-local.
+        self._zd_local = threading.local()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @classmethod
+    def init(cls, store: ObjectStore, password: Optional[str] = None,
+             chunker: Optional[dict] = None) -> "Repository":
+        """Initialize a fresh repository. The config write is atomic
+        create-if-absent, so two movers racing to initialize one shared
+        repository can never clobber each other's config/salt (one wins,
+        the loser gets RepoError and opens the winner's repo — a silent
+        overwrite would make every earlier sealed object MAC-fail)."""
+        if store.exists("config"):
+            raise RepoError("repository already initialized")
+        import os
+
+        salt = os.urandom(16) if password else None
+        box = crypto.make_box(password, salt or b"")
+        config = {
+            "version": 1,
+            "id": hashlib.sha256(os.urandom(32)).hexdigest(),
+            # align=4096: page-aligned cuts (ops/gearcdc.DEFAULT_PARAMS
+            # rationale) — new repos chunk on the 4 KiB Merkle-leaf grid
+            # so the fused single-dispatch engine (ops/segment.py)
+            # hashes leaves as contiguous pages. Repos created without
+            # the key keep align=1 (classic shift-invariant CDC), and
+            # align=64 repos keep the split-phase engine, so historical
+            # chunk boundaries and dedup remain valid either way.
+            "chunker": chunker or dict(DEFAULT_CHUNKER),
+            "salt": salt.hex() if salt else None,
+            "verifier": box.seal(_VERIFIER_PLAINTEXT).hex() if password else None,
+        }
+        payload = json.dumps(config).encode()
+        # put_if_absent is a hard ObjectStore requirement (no silent
+        # non-atomic fallback: that would quietly reintroduce the
+        # config-clobber race for a store that forgot to implement it).
+        if not store.put_if_absent("config", payload):
+            raise RepoError("repository already initialized")
+        return cls(store, box, config)
+
+    @classmethod
+    def open(cls, store: ObjectStore,
+             password: Optional[str] = None) -> "Repository":
+        try:
+            config = json.loads(store.get("config"))
+        except NoSuchKey:
+            raise RepoError("no repository at this location "
+                            "(missing config)") from None
+        if config.get("salt"):
+            if not password:
+                raise crypto.WrongPassword("repository is encrypted")
+            box = crypto.make_box(password, bytes.fromhex(config["salt"]))
+            try:
+                if box.open(bytes.fromhex(config["verifier"])) != _VERIFIER_PLAINTEXT:
+                    raise crypto.WrongPassword("bad password")
+            except crypto.IntegrityError:
+                raise crypto.WrongPassword("bad password") from None
+        else:
+            box = crypto.PlainBox()
+        repo = cls(store, box, config)
+        repo.load_index()
+        return repo
+
+    @property
+    def chunker_params(self) -> dict:
+        return dict(self.config["chunker"])
+
+    # -- locking ------------------------------------------------------------
+    #
+    # restic-style lock objects in the store (locks/<id>): writers take a
+    # shared lock, prune/forget take an exclusive lock, so a concurrent
+    # prune can never sweep a live backup's freshly written packs/index
+    # deltas. Create-then-check (restic's own protocol): write our lock
+    # object first, then scan for conflicts; back out on conflict. Locks
+    # older than LOCK_STALE_SECONDS are treated as crashed holders and
+    # removed; live holders refresh their lock's timestamp every
+    # LOCK_REFRESH_SECONDS (restic's ~5-minute refresh) so a long-running
+    # backup is never mistaken for a crash.
+
+    LOCK_STALE_SECONDS = 30 * 60
+    LOCK_REFRESH_SECONDS = 5 * 60
+
+    #: Default contention wait for lock() callers that don't pass one
+    #: (movers raise it so a shared/exclusive collision between two CRs
+    #: waits out the other side instead of failing the whole sync).
+    default_lock_wait: float = 0.0
+
+    def _write_lock(self, exclusive: bool) -> str:
+        import os
+        import socket
+
+        payload = json.dumps({
+            "exclusive": exclusive,
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "time": datetime.now(timezone.utc).isoformat(),
+        }).encode()
+        lock_id = hashlib.sha256(payload + os.urandom(16)).hexdigest()
+        self.store.put(f"locks/{lock_id}", payload)
+        return f"locks/{lock_id}"
+
+    def _conflicting_lock(self, own_key: str,
+                          exclusive: bool) -> Optional[str]:
+        now = datetime.now(timezone.utc)
+        for key in list(self.store.list("locks/")):
+            if key == own_key:
+                continue
+            try:
+                info = json.loads(self.store.get(key))
+            except (NoSuchKey, ValueError):
+                continue
+            try:
+                age = (now - _parse_time(info["time"])).total_seconds()
+            except (KeyError, ValueError):
+                age = self.LOCK_STALE_SECONDS + 1
+            if age > self.LOCK_STALE_SECONDS:
+                self.store.delete(key)  # crashed holder
+                continue
+            if exclusive or info.get("exclusive"):
+                return key
+        return None
+
+    @contextmanager
+    def lock(self, *, exclusive: bool = False,
+             wait_seconds: Optional[float] = None):
+        """Hold a repository lock for the duration of the with-block.
+
+        Raises RepoLockedError if a conflicting lock persists past
+        ``wait_seconds`` (default: ``self.default_lock_wait``).
+        """
+        if wait_seconds is None:
+            wait_seconds = self.default_lock_wait
+        own: Optional[str] = self._write_lock(exclusive)
+        stop = threading.Event()
+        refresher = None
+        try:
+            deadline = time_mod.monotonic() + wait_seconds
+            while True:
+                conflict = self._conflicting_lock(own, exclusive)
+                if conflict is None:
+                    break
+                # Back out before waiting (restic's protocol): keeping our
+                # lock in the store while polling would make two
+                # concurrent acquirers block each other forever.
+                self.store.delete(own)
+                own = None
+                if time_mod.monotonic() >= deadline:
+                    raise RepoLockedError(
+                        f"repository is locked by {conflict} "
+                        f"(wanted {'exclusive' if exclusive else 'shared'})")
+                # Randomized backoff: two contenders started in lock-step
+                # (same cron tick on two hosts) must desynchronize, or
+                # they re-collide every round until both time out.
+                import random
+
+                time_mod.sleep(
+                    min(1.0, max(wait_seconds, 0.1)) * random.uniform(0.2, 1.0))
+                own = self._write_lock(exclusive)
+
+            lock_key = own
+
+            def refresh():
+                while not stop.wait(self.LOCK_REFRESH_SECONDS):
+                    try:
+                        info = json.loads(self.store.get(lock_key))
+                        info["time"] = datetime.now(timezone.utc).isoformat()
+                        if stop.is_set():  # released while we were reading
+                            break
+                        self.store.put(lock_key, json.dumps(info).encode())
+                    except Exception:  # noqa: BLE001 — keep holding
+                        pass
+                # The refresher owns deletion: by the time we get here any
+                # in-flight refresh put has completed, so the delete cannot
+                # be resurrected behind our back (an orphaned fresh-looking
+                # lock would block exclusive ops for LOCK_STALE_SECONDS).
+                try:
+                    self.store.delete(lock_key)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            refresher = threading.Thread(target=refresh, daemon=True)
+            refresher.start()
+            yield
+        finally:
+            stop.set()
+            if refresher is not None:
+                # The refresher deletes the lock when it exits; the join
+                # just bounds how long release waits for that.
+                refresher.join(timeout=10.0)
+            elif own is not None:
+                try:
+                    self.store.delete(own)
+                except NoSuchKey:
+                    pass
+
+    # -- index --------------------------------------------------------------
+
+    def load_index(self):
+        """(Re)read index deltas from the store.
+
+        Entries for blobs this process has written but not yet persisted
+        to an index object — the open pack's buffer and _pending_index —
+        are preserved: a mid-lifecycle reload (backup/restore re-reading
+        after lock acquisition) must not wipe a concurrent local writer's
+        in-flight state.
+        """
+        with self._lock:
+            self._index.clear()
+            # Streaming: one index delta decoded at a time; entries land
+            # in the flat compact index, never in per-entry objects.
+            for key in self.store.list("index/"):
+                payload = json.loads(
+                    self._zd.decompress(self.box.open(self.store.get(key)))
+                )  # under self._lock; _zd is per-thread anyway
+                for pack_id, entries in payload["packs"].items():
+                    for e in entries:
+                        self._index.insert(
+                            e["id"], pack_id, e["type"], e["offset"],
+                            e["length"], e["raw_length"])
+            for pack_id, entries in self._pending_index.items():
+                for e in entries:
+                    self._index.insert(
+                        e["id"], pack_id, e["type"], e["offset"],
+                        e["length"], e["raw_length"], replace=False)
+            for e in self._cur_entries:
+                self._index.insert(
+                    e["id"], "", e["type"], e["offset"], e["length"],
+                    e["raw_length"], replace=False)
+
+    def has_blob(self, blob_id: str) -> bool:
+        with self._lock:
+            return blob_id in self._index
+
+    def blob_ids(self) -> set:
+        with self._lock:
+            return set(self._index)
+
+    def _entry(self, blob_id: str) -> Optional[IndexEntry]:
+        tup = self._index.lookup(blob_id)
+        if tup is None:
+            return None
+        pack, btype, offset, length, raw_length = tup
+        return IndexEntry(pack=pack, type=btype, offset=offset,
+                          length=length, raw_length=raw_length)
+
+    # -- write path ---------------------------------------------------------
+
+    def _encode_blob(self, data: bytes) -> bytes:
+        comp = self._zc.compress(data)
+        if len(comp) <= len(data) * _COMPRESS_MIN_GAIN:
+            return self.box.seal(b"\x01" + comp)
+        return self.box.seal(b"\x00" + data)
+
+    @property
+    def _zd(self):
+        zd = getattr(self._zd_local, "zd", None)
+        if zd is None:
+            zd = self._zd_local.zd = zstandard.ZstdDecompressor()
+        return zd
+
+    def _decode_blob(self, sealed: bytes) -> bytes:
+        plain = self.box.open(sealed)
+        if plain[:1] == b"\x01":
+            return self._zd.decompress(plain[1:])
+        return plain[1:]
+
+    def add_blob(self, btype: str, blob_id: str, data: bytes,
+                 stats: Optional[BackupStats] = None) -> bool:
+        """Store a blob unless present. Returns True if newly stored."""
+        with self._lock:
+            if blob_id in self._index:
+                if stats:
+                    stats.blobs_dedup += 1
+                    stats.bytes_dedup += len(data)
+                return False
+            seg = self._encode_blob(data)
+            self._cur_entries.append({
+                "id": blob_id, "type": btype, "offset": self._cur_size,
+                "length": len(seg), "raw_length": len(data),
+            })
+            self._cur_segments.append(seg)
+            self._cur_size += len(seg)
+            # visible to dedup immediately (pack id filled at flush)
+            self._index.insert(blob_id, "", btype,
+                               self._cur_entries[-1]["offset"], len(seg),
+                               len(data))
+            if stats:
+                stats.blobs_new += 1
+                stats.bytes_new += len(data)
+                stats.bytes_stored += len(seg)
+            if self._cur_size >= self.PACK_TARGET:
+                self._flush_pack()
+            return True
+
+    def _flush_pack(self):
+        if not self._cur_segments:
+            return
+        body = b"".join(self._cur_segments)
+        header = self.box.seal(
+            self._zc.compress(json.dumps(self._cur_entries).encode())
+        )
+        blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
+        pack_id = hashlib.sha256(blob).hexdigest()
+        self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
+        for e in self._cur_entries:
+            cur = self._index.lookup(e["id"])
+            if cur is None or cur[0] == "":
+                # bind the buffered entry to its now-durable pack (or
+                # re-add if a load_index dropped it — always safe)
+                self._index.insert(e["id"], pack_id, e["type"], e["offset"],
+                                   e["length"], e["raw_length"])
+            # else: rebound to a store-sourced pack by load_index — its
+            # offset/length belong to that pack; leave it pointing there
+        self._pending_index[pack_id] = self._cur_entries
+        self._pending_count += len(self._cur_entries)
+        self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
+        if self._pending_count >= self.PENDING_INDEX_LIMIT:
+            self._persist_pending()
+
+    def _persist_pending(self):
+        """Write buffered index entries as one index delta object."""
+        if not self._pending_index:
+            return
+        payload = self.box.seal(self._zc.compress(json.dumps(
+            {"packs": self._pending_index}
+        ).encode()))
+        idx_id = hashlib.sha256(payload).hexdigest()
+        self.store.put(f"index/{idx_id}", payload)
+        self._pending_index = {}
+        self._pending_count = 0
+
+    def flush(self):
+        """Flush the open pack and persist an index delta."""
+        with self._lock:
+            self._flush_pack()
+            self._persist_pending()
+
+    # -- read path ----------------------------------------------------------
+
+    def read_blob(self, blob_id: str) -> bytes:
+        with self._lock:
+            entry = self._entry(blob_id)
+            if entry is None:
+                raise RepoError(f"blob {blob_id} not in index")
+            if entry.pack == "":  # still buffered in the open pack
+                for e, seg in zip(self._cur_entries, self._cur_segments):
+                    if e["id"] == blob_id:
+                        return self._decode_blob(seg)
+                raise RepoError(f"blob {blob_id} buffered but missing")
+        return self._read_packed(blob_id, entry)
+
+    def _read_packed(self, blob_id: str, entry: IndexEntry) -> bytes:
+        """Fetch + decode + verify a flushed blob WITHOUT touching
+        self._lock — safe for worker pools even while another thread
+        holds the lock (prune's rewrite readers)."""
+        sealed = self.store.get_range(
+            f"data/{entry.pack[:2]}/{entry.pack}", entry.offset, entry.length
+        )
+        data = self._decode_blob(sealed)
+        got = blobid.blob_id(data)
+        if got != blob_id:
+            raise crypto.IntegrityError(
+                f"blob {blob_id}: content hash mismatch ({got})"
+            )
+        return data
+
+    # -- snapshots ----------------------------------------------------------
+
+    def save_snapshot(self, manifest: dict) -> str:
+        manifest.setdefault("time", datetime.now(timezone.utc).isoformat())
+        payload = self.box.seal(json.dumps(manifest).encode())
+        snap_id = hashlib.sha256(payload).hexdigest()
+        self.store.put(f"snapshots/{snap_id}", payload)
+        return snap_id
+
+    def list_snapshots(self) -> list[tuple[str, dict]]:
+        out = []
+        for key in self.store.list("snapshots/"):
+            snap_id = key.split("/", 1)[1]
+            manifest = json.loads(self.box.open(self.store.get(key)))
+            out.append((snap_id, manifest))
+        # Chronological, not lexicographic: manifests may carry non-UTC
+        # offsets, where the ISO strings don't sort by instant.
+        out.sort(key=lambda kv: _parse_time(kv[1]["time"]))
+        return out
+
+    def delete_snapshot(self, snap_id: str):
+        self.store.delete(f"snapshots/{snap_id}")
+
+    def select_snapshot(self, restore_as_of: Optional[datetime] = None,
+                        previous: int = 0) -> Optional[tuple[str, dict]]:
+        """Point-in-time selection (mover-restic/entry.sh:146-200
+        semantics): newest snapshot with time <= restore_as_of, then step
+        back ``previous`` more."""
+        snaps = self.list_snapshots()
+        if restore_as_of is not None:
+            if restore_as_of.tzinfo is None:
+                # Naive selector (e.g. RESTORE_AS_OF without an offset):
+                # interpret as UTC rather than crash on aware-vs-naive.
+                restore_as_of = restore_as_of.replace(tzinfo=timezone.utc)
+            snaps = [s for s in snaps
+                     if _parse_time(s[1]["time"]) <= restore_as_of]
+        if not snaps:
+            return None
+        idx = len(snaps) - 1 - previous
+        if idx < 0:
+            return None
+        return snaps[idx]
+
+    # -- retention / GC -----------------------------------------------------
+
+    def forget(self, *, last: Optional[int] = None,
+               hourly: Optional[int] = None, daily: Optional[int] = None,
+               weekly: Optional[int] = None, monthly: Optional[int] = None,
+               yearly: Optional[int] = None,
+               within: Optional[timedelta] = None) -> list[str]:
+        """Apply a restic-style retain policy; returns deleted snapshot ids
+        (restic ``forget`` — the FORGET_OPTIONS the reference builds in
+        controllers/mover/restic/mover.go:440-471)."""
+        with self.lock(exclusive=True):
+            return self._forget_locked(
+                last=last, hourly=hourly, daily=daily, weekly=weekly,
+                monthly=monthly, yearly=yearly, within=within)
+
+    def _forget_locked(self, *, last=None, hourly=None, daily=None,
+                       weekly=None, monthly=None, yearly=None,
+                       within=None) -> list[str]:
+        snaps = self.list_snapshots()
+        if not snaps:
+            return []
+        keep: set[str] = set()
+        # _parse_time throughout: a repository mixing naive and tz-aware
+        # snapshot times must not raise on aware-vs-naive comparison.
+        newest_time = _parse_time(snaps[-1][1]["time"])
+        if last:
+            keep.update(sid for sid, _ in snaps[-last:])
+        if within:
+            keep.update(
+                sid for sid, m in snaps
+                if _parse_time(m["time"]) >= newest_time - within
+            )
+        buckets = (
+            (hourly, "%Y-%m-%d-%H"), (daily, "%Y-%m-%d"),
+            (weekly, "%G-%V"), (monthly, "%Y-%m"), (yearly, "%Y"),
+        )
+        for count, fmt in buckets:
+            if not count:
+                continue
+            seen: dict[str, str] = {}
+            for sid, m in snaps:  # ascending: later overwrites keep newest
+                seen[_parse_time(m["time"]).strftime(fmt)] = sid
+            for bucket_key in sorted(seen, reverse=True)[:count]:
+                keep.add(seen[bucket_key])
+        if not keep:  # a policy that keeps nothing keeps the newest
+            keep.add(snaps[-1][0])
+        doomed = [sid for sid, _ in snaps if sid not in keep]
+        for sid in doomed:
+            self.delete_snapshot(sid)
+        return doomed
+
+    def referenced_blobs(self) -> set:
+        """Walk all snapshot trees; returns reachable blob ids (hex)."""
+        import numpy as np
+
+        keys = self._referenced_keys()
+        # u8-row extraction: S-dtype scalar conversion strips trailing
+        # NUL bytes (~1/256 ids end in 0x00 and would truncate).
+        rows = keys.view(np.uint8).reshape(-1, 32)
+        return {rows[i].tobytes().hex() for i in range(rows.shape[0])}
+
+    def _referenced_keys(self):
+        """Reachable blob ids as a SORTED (N,) ``S32`` numpy array of
+        raw 32-byte ids — 32 bytes/blob instead of ~180 for a hex-string
+        set, and O(log n) vectorized membership for prune."""
+        import numpy as np
+
+        ids = bytearray()
+        seen_trees: set[str] = set()
+        stack = [m["tree"] for _, m in self.list_snapshots()]
+        while stack:
+            tree_id = stack.pop()
+            if tree_id in seen_trees:
+                continue
+            seen_trees.add(tree_id)
+            ids += bytes.fromhex(tree_id)
+            tree = json.loads(self.read_blob(tree_id))
+            for entry in tree["entries"]:
+                if entry["type"] == "dir":
+                    stack.append(entry["subtree"])
+                elif entry["type"] == "file":
+                    for b in entry["content"]:
+                        ids += bytes.fromhex(b)
+        if not ids:
+            return np.empty((0,), dtype="S32")
+        return np.unique(np.frombuffer(bytes(ids), dtype="S32"))
+
+    def prune(self) -> dict:
+        """Drop unreferenced blobs by rewriting partially-live packs
+        (restic ``prune`` — cadence governed by the mover's
+        prune_interval_days, SURVEY.md §2 #12).
+
+        Crash-safety ordering — data is never deleted before its
+        replacement is durable:
+          1. rewrite live blobs of partially-live packs into new packs
+             and FLUSH them;
+          2. write the consolidated index;
+          3. delete superseded index deltas;
+          4. sweep pack objects not referenced by the new index (this
+             also collects orphans left by a crash in an earlier prune).
+        A crash between any steps leaves a repository where every
+        snapshot still restores. Takes an exclusive repository lock so a
+        concurrent backup's packs/index deltas are never swept.
+        """
+        import numpy as np
+
+        with self.lock(exclusive=True), self._lock:
+            self.flush()
+            reach = self._referenced_keys()
+            # Whole-index liveness in vectorized passes: membership via
+            # one batched searchsorted over raw 32-byte keys, per-pack
+            # totals via bincount — no per-blob Python probes, no id
+            # materialization outside the dirty packs.
+            keys, pack_codes, pack_names = self._index.snapshot_arrays()
+            if reach.size and keys.size:
+                pos = np.clip(np.searchsorted(reach, keys), 0,
+                              reach.size - 1)
+                live_mask = reach[pos] == keys
+            else:
+                live_mask = np.zeros((keys.size,), dtype=bool)
+            totals = np.bincount(pack_codes, minlength=len(pack_names))
+            lives = np.bincount(pack_codes[live_mask],
+                                minlength=len(pack_names))
+            dirty_codes = np.nonzero(lives < totals)[0]
+            removed_blobs = 0
+            rewritten = 0
+            # Per-dirty-pack work lists; ids decode to hex only here.
+            # Extraction goes through a u8 row view: S-dtype scalar
+            # conversion strips trailing NUL bytes, which would truncate
+            # ~1/256 blob ids and crash the rewrite.
+            keys_u8 = keys.view(np.uint8).reshape(-1, 32)
+            order = np.argsort(pack_codes, kind="stable")
+            sorted_codes = pack_codes[order]
+            work: dict[str, list[str]] = {}
+            doomed: list[str] = []
+            for code in dirty_codes:
+                lo = np.searchsorted(sorted_codes, code, "left")
+                hi = np.searchsorted(sorted_codes, code, "right")
+                rows = order[lo:hi]
+                live_ids = [keys_u8[r].tobytes().hex() for r in rows
+                            if live_mask[r]]
+                doomed.extend(keys_u8[r].tobytes().hex() for r in rows
+                              if not live_mask[r])
+                if live_ids:
+                    work[pack_names[code]] = live_ids
+            # Rewrite one pack at a time; its live blobs are read
+            # CONCURRENTLY via the lock-free reader (store IO + decrypt
+            # overlap — the same pool pattern as check(); read_blob
+            # itself would deadlock on self._lock, which prune holds),
+            # then re-added under the new pack generation. Peak
+            # buffering is one pack's live payload.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(8) as pool:
+                for pack_id, live_ids in work.items():
+                    jobs = [(b, self._entry(b)) for b in live_ids]
+                    datas = list(pool.map(
+                        lambda j: self._read_packed(j[0], j[1]), jobs))
+                    for (blob_id, entry), data in zip(jobs, datas):
+                        self._index.remove(blob_id)
+                        self.add_blob(entry.type, blob_id, data)
+                    rewritten += 1
+            # fully-dead packs: nothing to rewrite, still swept
+            rewritten += len(dirty_codes) - len(work)
+            for blob_id in doomed:
+                self._index.remove(blob_id)
+                removed_blobs += 1
+            self._flush_pack()  # step 1 durable before anything is deleted
+            self._index.vacuum()
+            # Step 2: consolidated index, SHARDED into bounded delta
+            # objects (~PENDING_INDEX_LIMIT entries each) so no single
+            # index object — or its in-memory JSON — scales with the
+            # whole repository.
+            new_keys: set[str] = set()
+            shard: dict[str, list[dict]] = {}
+            count = 0
+
+            def emit_shard():
+                nonlocal shard, count
+                if not shard:
+                    return
+                payload = self.box.seal(self._zc.compress(
+                    json.dumps({"packs": shard}).encode()))
+                key = f"index/{hashlib.sha256(payload).hexdigest()}"
+                self.store.put(key, payload)
+                new_keys.add(key)
+                shard = {}
+                count = 0
+
+            for blob_id, (pack, btype, offset, length, raw) in \
+                    self._index.items():
+                shard.setdefault(pack, []).append({
+                    "id": blob_id, "type": btype, "offset": offset,
+                    "length": length, "raw_length": raw,
+                })
+                count += 1
+                if count >= self.PENDING_INDEX_LIMIT:
+                    emit_shard()
+            emit_shard()
+            # Step 3: drop superseded deltas.
+            for key in list(self.store.list("index/")):
+                if key not in new_keys:
+                    self.store.delete(key)
+            # Step 4: sweep unreferenced pack objects.
+            live_packs = {f"data/{p[:2]}/{p}"
+                          for p in self._index.live_packs() if p}
+            for key in list(self.store.list("data/")):
+                if key not in live_packs:
+                    self.store.delete(key)
+            self._pending_index = {}
+            self._pending_count = 0
+            return {"packs_rewritten": rewritten,
+                    "blobs_removed": removed_blobs,
+                    "snapshots": len(self.list_snapshots())}
+
+    # -- verification -------------------------------------------------------
+
+    def check(self, read_data: bool = False, *,
+              workers: int = 4) -> list[str]:
+        """Structural check (restic ``check``): every indexed blob's pack
+        exists; every blob reachable from any snapshot (sub-trees and
+        file content included) is present in the index; with read_data,
+        every indexed blob decrypts and re-hashes to its id (``workers``
+        blobs verified concurrently — store IO + decrypt overlap;
+        read_blob and the zstd path are thread-safe)."""
+        problems = []
+        with self._lock:
+            entries = self._index.copy()  # three array copies, no objects
+        to_read: list[str] = []
+        packs_seen: dict[str, bool] = {}  # pack id -> exists (memoized)
+        for blob_id, (pack, *_rest) in entries.items():
+            if not pack:
+                problems.append(f"blob {blob_id}: unflushed")
+                continue
+            ok = packs_seen.get(pack)
+            if ok is None:
+                ok = packs_seen[pack] = self.store.exists(
+                    f"data/{pack[:2]}/{pack}")
+            if not ok:
+                problems.append(f"blob {blob_id}: pack {pack} missing")
+                continue
+            if read_data:
+                to_read.append(blob_id)
+        if to_read:
+            def verify(blob_id: str):
+                try:
+                    self.read_blob(blob_id)
+                    return None
+                except Exception as ex:  # noqa: BLE001 — report, don't die
+                    return f"blob {blob_id}: {ex}"
+
+            if workers > 1 and len(to_read) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(workers) as pool:
+                    problems.extend(p for p in pool.map(verify, to_read)
+                                    if p)
+            else:
+                problems.extend(p for p in map(verify, to_read) if p)
+        # Deep reachability: a snapshot is restorable only if its whole
+        # tree closure resolves through the index.
+        seen: set[str] = set()
+        for snap_id, manifest in self.list_snapshots():
+            stack = [manifest["tree"]]
+            while stack:
+                tree_id = stack.pop()
+                if tree_id in seen:
+                    continue
+                seen.add(tree_id)
+                if tree_id not in entries:
+                    problems.append(
+                        f"snapshot {snap_id}: tree {tree_id} not in index")
+                    continue
+                try:
+                    tree = json.loads(self.read_blob(tree_id))
+                except Exception as ex:  # noqa: BLE001
+                    problems.append(f"snapshot {snap_id}: tree {tree_id}: {ex}")
+                    continue
+                for entry in tree["entries"]:
+                    if entry["type"] == "dir":
+                        stack.append(entry["subtree"])
+                    elif entry["type"] == "file":
+                        for b in entry["content"]:
+                            if b not in entries and b not in seen:
+                                seen.add(b)
+                                problems.append(
+                                    f"snapshot {snap_id}: data blob {b} "
+                                    "not in index")
+        return problems

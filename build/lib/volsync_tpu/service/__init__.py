@@ -1,0 +1,8 @@
+"""mover-jax: the TPU chunk/hash data plane as a gRPC service
+(BASELINE.json north star; SURVEY.md §2.3 communication backend).
+"""
+
+from volsync_tpu.service.client import MoverJaxClient, open_client
+from volsync_tpu.service.server import MoverJaxServer
+
+__all__ = ["MoverJaxServer", "MoverJaxClient", "open_client"]

@@ -1,0 +1,96 @@
+"""mover-jax typed client.
+
+What a remote mover links against instead of a local engine: stream a
+volume (any ``reader(n)``) to the service and iterate finalized chunks;
+batch-hash spans; discover the serving backend. Every call carries the
+service token (server aborts UNAUTHENTICATED otherwise).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import grpc
+
+from volsync_tpu.service import moverjax_pb2 as pb
+from volsync_tpu.service.server import SERVICE_NAME, TOKEN_METADATA_KEY
+
+_SEND_CHUNK = 4 * 1024 * 1024
+
+
+class MoverJaxClient:
+    def __init__(self, address: str, port: int, token: str,
+                 timeout: float = 60.0):
+        self._channel = grpc.insecure_channel(f"{address}:{port}")
+        self._meta = ((TOKEN_METADATA_KEY, token),)
+        self._timeout = timeout
+        ser = lambda m: m.SerializeToString()  # noqa: E731
+        self._chunk_hash = self._channel.stream_stream(
+            f"/{SERVICE_NAME}/ChunkHash",
+            request_serializer=ser,
+            response_deserializer=pb.ChunkBatch.FromString)
+        self._hash_spans = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/HashSpans",
+            request_serializer=ser,
+            response_deserializer=pb.HashSpansResponse.FromString)
+        self._info = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Info",
+            request_serializer=ser,
+            response_deserializer=pb.InfoResponse.FromString)
+
+    def close(self):
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- calls ---------------------------------------------------------------
+
+    def chunk_stream(self, reader: Callable[[int], bytes],
+                     ) -> Iterator[tuple[int, int, str]]:
+        """Stream ``reader`` to the service -> (offset, length, digest)
+        per finalized chunk, in order, covering the whole stream."""
+
+        def segments():
+            while True:
+                piece = reader(_SEND_CHUNK)
+                if not piece:
+                    yield pb.DataSegment(data=b"", eof=True)
+                    return
+                yield pb.DataSegment(data=piece)
+
+        for batch in self._chunk_hash(segments(), metadata=self._meta,
+                                      timeout=self._timeout):
+            for c in batch.chunks:
+                yield int(c.offset), int(c.length), c.digest
+
+    def chunk_bytes(self, data: bytes) -> list[tuple[int, int, str]]:
+        view = memoryview(data)
+        pos = [0]
+
+        def read(n: int) -> bytes:
+            piece = bytes(view[pos[0]: pos[0] + n])
+            pos[0] += len(piece)
+            return piece
+
+        return list(self.chunk_stream(read))
+
+    def hash_spans(self, data: bytes,
+                   spans: list[tuple[int, int]]) -> list[str]:
+        req = pb.HashSpansRequest(data=data)
+        for off, length in spans:
+            req.spans.append(pb.Span(offset=off, length=length))
+        reply = self._hash_spans(req, metadata=self._meta,
+                                 timeout=self._timeout)
+        return list(reply.digests)
+
+    def info(self) -> pb.InfoResponse:
+        return self._info(pb.InfoRequest(), metadata=self._meta,
+                          timeout=self._timeout)
+
+
+def open_client(address: str, port: int, token: str) -> MoverJaxClient:
+    return MoverJaxClient(address, port, token)

@@ -1,0 +1,3 @@
+"""Version of the framework (reference tracks 0.6.0 in version.mk:12)."""
+
+__version__ = "0.4.0"
