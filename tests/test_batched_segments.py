@@ -133,3 +133,67 @@ def test_batched_hasher_driver(rng):
         assert chunks == want
         for s, l, d in chunks[:2]:
             assert d == blobid.blob_id(buf[s: s + l])
+
+
+def test_treebackup_with_shared_batcher(tmp_path, monkeypatch):
+    """VOLSYNC_BATCH_SEGMENTS=1: TreeBackup's concurrent file workers
+    coalesce segments through the shared microbatcher and the snapshot
+    is bit-identical to the unbatched run."""
+    import os
+
+    from volsync_tpu.engine import TreeBackup, restore_snapshot
+    from volsync_tpu.objstore import MemObjectStore
+    from volsync_tpu.ops import batcher as batcher_mod
+    from volsync_tpu.repo.repository import Repository
+
+    rng = np.random.RandomState(9)
+    src = tmp_path / "src"
+    src.mkdir()
+    for i in range(6):
+        (src / f"f{i}.bin").write_bytes(rng.bytes(150_000 + i * 7000))
+
+    chunker_cfg = {"min_size": P.min_size, "avg_size": P.avg_size,
+                   "max_size": P.max_size, "seed": P.seed, "align": 4096}
+
+    # unbatched reference run
+    repo_a = Repository.init(MemObjectStore(), chunker=chunker_cfg)
+    snap_a, stats_a = TreeBackup(repo_a, workers=4).run(src)
+
+    # batched run through a fresh shared batcher
+    monkeypatch.setenv("VOLSYNC_BATCH_SEGMENTS", "1")
+    monkeypatch.setenv("VOLSYNC_BATCH_WINDOW_MS", "25")
+    monkeypatch.setattr(batcher_mod, "_SHARED", {})
+    batch_sizes = []
+    orig_init = batcher_mod.SegmentMicroBatcher.__init__
+
+    def spy_init(self, params, **kw):
+        orig_init(self, params, **kw)
+        real = self._hasher.hash_segments
+
+        def spy(items):
+            batch_sizes.append(len(items))
+            return real(items)
+
+        self._hasher.hash_segments = spy
+
+    monkeypatch.setattr(batcher_mod.SegmentMicroBatcher, "__init__",
+                        spy_init)
+    repo_b = Repository.init(MemObjectStore(), chunker=chunker_cfg)
+    try:
+        snap_b, stats_b = TreeBackup(repo_b, workers=4).run(src)
+    finally:
+        # don't leak the worker thread into the rest of the session
+        for b in batcher_mod._SHARED.values():
+            b.stop()
+
+    # identical content: same blob universe, restore matches
+    assert repo_a.blob_ids() == repo_b.blob_ids()
+    assert stats_a.blobs_new == stats_b.blobs_new
+    dst = tmp_path / "dst"
+    dst.mkdir()
+    restore_snapshot(repo_b, dst)
+    for i in range(6):
+        assert (dst / f"f{i}.bin").read_bytes() == \
+            (src / f"f{i}.bin").read_bytes()
+    # concurrency actually coalesced
+    assert batch_sizes and any(s > 1 for s in batch_sizes), batch_sizes

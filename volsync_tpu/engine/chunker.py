@@ -87,6 +87,11 @@ class DeviceChunkHasher:
     #: (the fused hasher is stateless; jit caches are global/locked).
     thread_safe = True
 
+    #: Owners that manage their own batching (MoverJaxServer) set this
+    #: False so the process-wide VOLSYNC_BATCH_SEGMENTS hook cannot
+    #: override their explicit per-request configuration.
+    use_shared_batcher = True
+
     def __init__(self, params: GearParams):
         self.params = params
         from volsync_tpu.ops.segment import LEAF_SIZE
@@ -109,7 +114,13 @@ class DeviceChunkHasher:
         one in-flight result, so ``.chunks``/``.end`` block until the
         fetch; on the split-phase path (align < 4096) the boundary walk
         runs synchronously here and only the leaf digests stay in
-        flight."""
+        flight.
+
+        With VOLSYNC_BATCH_SEGMENTS=1 (ops/batcher.shared_batcher) the
+        fused path routes through the process-wide microbatcher:
+        concurrent workers' segments — different files of one
+        TreeBackup, different CRs' movers in one operator — coalesce
+        into single cross-PVC batched dispatches."""
         import jax.numpy as jnp
 
         if isinstance(buffer, (bytes, bytearray, memoryview)):
@@ -123,6 +134,19 @@ class DeviceChunkHasher:
                 return PendingSegment([], None, None)
             return PendingSegment(
                 [(0, length, blobid.blob_id(buffer.tobytes()))], None, None)
+
+        if (self.use_shared_batcher and self.fused is not None
+                and self.fused.segment_device_fn is None):
+            from volsync_tpu.ops.batcher import shared_batcher
+
+            batcher = shared_batcher(p)
+            if batcher is not None:
+                # consumed == the last chunk's end by the walk's
+                # semantics, which is exactly what PendingSegment.end
+                # derives from the chunk list. The ndarray passes
+                # through uncopied (submit blocks, so it stays alive).
+                chunks, _consumed = batcher.submit(buffer, length, eof)
+                return PendingSegment(chunks, None, None)
 
         padded = _buffer_bucket(length)
         if padded != length:

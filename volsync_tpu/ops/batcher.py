@@ -1,0 +1,122 @@
+"""Cross-stream segment microbatching (shared by service + local engine).
+
+Concurrent producers — gRPC ChunkHash handlers (service/server.py) or
+TreeBackup's per-file workers (engine/backup.py) — submit segments
+that coalesce into ONE batched device dispatch
+(ops/segment.chunk_hash_segments): the service/engine-side form of
+BASELINE configs[5]'s cross-PVC batching. A lone producer pays at most
+``window_ms``; a busy pipeline pays it never (the queue is already
+non-empty when the worker looks).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import Future
+
+from volsync_tpu.ops.gearcdc import GearParams
+
+
+class SegmentMicroBatcher:
+    """Queue + worker thread: the first item waits up to ``window_ms``
+    for companions (bounded by ``max_batch``), the batch dispatches via
+    BatchedSegmentHasher, and each caller's future resolves with its
+    lane. ``stop()`` drains the queue — a future enqueued before stop
+    is always resolved, never stranded."""
+
+    def __init__(self, params: GearParams, *, max_batch: int = 16,
+                 window_ms: float = 2.0):
+        from volsync_tpu.ops.segment import BatchedSegmentHasher
+
+        self._hasher = BatchedSegmentHasher(params)
+        self._q: queue.Queue = queue.Queue()
+        self._max_batch = max_batch
+        self._window = window_ms / 1000.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="segment-microbatcher")
+        self._thread.start()
+
+    def submit(self, data: bytes, length: int, eof: bool):
+        """Blocking: returns (chunks, consumed) for this segment."""
+        if self._stop.is_set():
+            raise RuntimeError("microbatcher stopped")
+        f: Future = Future()
+        self._q.put((data, length, eof, f))
+        # The worker resolves every queued future (including at
+        # shutdown); the timeout is a last-ditch liveness bound so a
+        # producer thread can never hang the interpreter.
+        return f.result(timeout=600)
+
+    def _run(self):
+        import time as time_mod
+
+        while True:
+            try:
+                first = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            batch = [first]
+            deadline = time_mod.monotonic() + self._window
+            while len(batch) < self._max_batch:
+                remaining = deadline - time_mod.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            try:
+                results = self._hasher.hash_segments(
+                    [(d, n, e) for d, n, e, _ in batch])
+                for (_, _, _, f), r in zip(batch, results):
+                    f.set_result(r)
+            except Exception as exc:  # noqa: BLE001 — per-caller delivery
+                for _, _, _, f in batch:
+                    if not f.done():
+                        f.set_exception(exc)
+
+    def stop(self):
+        """Stop accepting work, then let the worker DRAIN the queue:
+        it exits only via the empty-queue check, so a future enqueued
+        before stop() is always resolved, never stranded."""
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        # Belt-and-braces: if the worker died abnormally, fail leftovers.
+        while True:
+            try:
+                _, _, _, f = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if not f.done():
+                f.set_exception(RuntimeError("microbatcher stopped"))
+
+
+_SHARED: dict = {}
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_batcher(params: GearParams):
+    """Process-wide microbatcher per chunker-params (the local engine's
+    opt-in batching path, VOLSYNC_BATCH_SEGMENTS=1): TreeBackup workers
+    hashing different files — and different CRs' movers in one operator
+    process — coalesce through one instance. Returns None when batching
+    is disabled or the params aren't page-aligned."""
+    if not os.environ.get("VOLSYNC_BATCH_SEGMENTS"):
+        return None
+    if params.align != 4096:
+        return None
+    with _SHARED_LOCK:
+        b = _SHARED.get(params)
+        if b is None:
+            b = _SHARED[params] = SegmentMicroBatcher(
+                params,
+                max_batch=int(os.environ.get(
+                    "VOLSYNC_BATCH_MAX", "16")),
+                window_ms=float(os.environ.get(
+                    "VOLSYNC_BATCH_WINDOW_MS", "2")))
+        return b

@@ -33,6 +33,7 @@ from typing import Optional
 import grpc
 import numpy as np
 
+from volsync_tpu.ops.batcher import SegmentMicroBatcher
 from volsync_tpu.service import moverjax_pb2 as pb
 
 log = logging.getLogger("volsync_tpu.moverjax")
@@ -61,96 +62,6 @@ class _TokenInterceptor(grpc.ServerInterceptor):
         return continuation(handler_call_details)
 
 
-class SegmentMicroBatcher:
-    """Cross-request segment batching: concurrent ChunkHash RPCs'
-    segments coalesce into ONE device dispatch (ops/segment.
-    chunk_hash_segments) instead of racing individual programs — the
-    service-side form of BASELINE configs[5]'s cross-PVC batching.
-
-    A worker thread drains the queue: the first item waits up to
-    ``window_ms`` for companions (bounded by ``max_batch``), the batch
-    dispatches, and each caller's future resolves with its lane. A lone
-    request therefore pays at most the window; a busy service pays it
-    never (the queue is already non-empty)."""
-
-    def __init__(self, params, *, max_batch: int = 16,
-                 window_ms: float = 2.0):
-        import queue
-        import threading
-
-        from volsync_tpu.ops.segment import BatchedSegmentHasher
-
-        self._hasher = BatchedSegmentHasher(params)
-        self._q: "queue.Queue" = queue.Queue()
-        self._max_batch = max_batch
-        self._window = window_ms / 1000.0
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="segment-microbatcher")
-        self._thread.start()
-
-    def submit(self, data: bytes, length: int, eof: bool):
-        """Blocking: returns (chunks, consumed) for this segment."""
-        from concurrent.futures import Future
-
-        if self._stop.is_set():
-            raise RuntimeError("microbatcher stopped")
-        f: Future = Future()
-        self._q.put((data, length, eof, f))
-        # The worker resolves every queued future (including at
-        # shutdown); the timeout is a last-ditch liveness bound so a
-        # gRPC handler thread can never hang the interpreter.
-        return f.result(timeout=600)
-
-    def _run(self):
-        import queue
-        import time as time_mod
-
-        while True:
-            try:
-                first = self._q.get(timeout=0.2)
-            except queue.Empty:
-                if self._stop.is_set():
-                    return
-                continue
-            batch = [first]
-            deadline = time_mod.monotonic() + self._window
-            while len(batch) < self._max_batch:
-                remaining = deadline - time_mod.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._q.get(timeout=remaining))
-                except queue.Empty:
-                    break
-            try:
-                results = self._hasher.hash_segments(
-                    [(d, n, e) for d, n, e, _ in batch])
-                for (_, _, _, f), r in zip(batch, results):
-                    f.set_result(r)
-            except Exception as exc:  # noqa: BLE001 — per-caller delivery
-                for _, _, _, f in batch:
-                    if not f.done():
-                        f.set_exception(exc)
-
-    def stop(self):
-        """Stop accepting work, then let the worker DRAIN the queue:
-        it exits only via the empty-queue check, so a future enqueued
-        before stop() is always resolved, never stranded."""
-        self._stop.set()
-        self._thread.join(timeout=30.0)
-        # Belt-and-braces: if the worker died abnormally, fail leftovers.
-        import queue
-
-        while True:
-            try:
-                _, _, _, f = self._q.get_nowait()
-            except queue.Empty:
-                break
-            if not f.done():
-                f.set_exception(RuntimeError("microbatcher stopped"))
-
-
 class MoverJaxServer:
     """One engine, many remote movers. ``token`` is the shared service
     secret (generated if not supplied — read it back via ``.token``).
@@ -170,6 +81,10 @@ class MoverJaxServer:
         self.segment_size = segment_size
         self.token = token or os.urandom(32).hex()
         self._hasher = DeviceChunkHasher(self.params)
+        # The server manages its own batching: the process-wide
+        # VOLSYNC_BATCH_SEGMENTS hook must not override an explicit
+        # batch_window_ms=0 per-request configuration.
+        self._hasher.use_shared_batcher = False
         self._batcher = None
         if batch_window_ms > 0 and self.params.align == 4096:
             self._batcher = SegmentMicroBatcher(
