@@ -258,3 +258,33 @@ def test_prune_survives_nul_tailed_blob_ids(monkeypatch):
     assert repo.read_blob(keep_id) == keep_data
     assert not repo.has_blob(doom_id)
     assert repo.check(read_data=True) == []
+
+
+def test_check_device_verify_matches_host(monkeypatch):
+    """check(read_data=True, device_verify=True): blob ids re-derive in
+    device batches (hash_spans) — same verdicts as the host path,
+    including detection of a corrupted pack byte."""
+    monkeypatch.setattr(Repository, "PACK_TARGET", 1 << 62)
+    store = MemObjectStore()
+    repo = Repository.init(store, chunker=SMALL_CHUNKER)
+    ids = []
+    for i in range(12):
+        data = _incompressible(i, 9000 + 311 * i)
+        bid = blobid.blob_id(data)
+        ids.append(bid)
+        repo.add_blob("data", bid, data)
+    repo.flush()
+
+    assert repo.check(read_data=True, device_verify=True) == []
+    assert repo.check(read_data=True, device_verify=False) == []
+
+    # flip one byte inside a stored pack: both paths must report the
+    # same corrupted blob (decrypt fails or the re-hash mismatches)
+    pack_key = next(k for k in store.list("data/"))
+    blob = bytearray(store.get(pack_key))
+    blob[100] ^= 0xFF
+    store.put(pack_key, bytes(blob))
+    dev = repo.check(read_data=True, device_verify=True)
+    host = repo.check(read_data=True, device_verify=False)
+    assert len(dev) == len(host) == 1
+    assert dev[0].split(":")[0] == host[0].split(":")[0]  # same blob

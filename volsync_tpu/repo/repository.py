@@ -463,19 +463,23 @@ class Repository:
                 raise RepoError(f"blob {blob_id} buffered but missing")
         return self._read_packed(blob_id, entry)
 
-    def _read_packed(self, blob_id: str, entry: IndexEntry) -> bytes:
-        """Fetch + decode + verify a flushed blob WITHOUT touching
-        self._lock — safe for worker pools even while another thread
-        holds the lock (prune's rewrite readers)."""
+    def _read_packed(self, blob_id: str, entry: IndexEntry, *,
+                     verify: bool = True) -> bytes:
+        """Fetch + decode (+ host-verify) a flushed blob WITHOUT
+        touching self._lock — safe for worker pools even while another
+        thread holds the lock (prune's rewrite readers).
+        ``verify=False`` skips the host re-hash for callers that verify
+        in device batches (check's device path)."""
         sealed = self.store.get_range(
             f"data/{entry.pack[:2]}/{entry.pack}", entry.offset, entry.length
         )
         data = self._decode_blob(sealed)
-        got = blobid.blob_id(data)
-        if got != blob_id:
-            raise crypto.IntegrityError(
-                f"blob {blob_id}: content hash mismatch ({got})"
-            )
+        if verify:
+            got = blobid.blob_id(data)
+            if got != blob_id:
+                raise crypto.IntegrityError(
+                    f"blob {blob_id}: content hash mismatch ({got})"
+                )
         return data
 
     # -- snapshots ----------------------------------------------------------
@@ -738,14 +742,80 @@ class Repository:
 
     # -- verification -------------------------------------------------------
 
+    _DEVICE_VERIFY_BATCH = 64 * 1024 * 1024
+
+    def _verify_blobs_device(self, blob_ids: list, workers: int) -> list:
+        """Re-hash blobs in device batches: a reader pool streams raw
+        plaintext (store IO + decrypt + decompress overlap, NO host
+        hashing), batches pack ~64 MiB of page-aligned spans, and one
+        fused dispatch per batch re-derives every blob id
+        (engine/chunker.hash_spans — the rclone checksum primitive)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from volsync_tpu.engine.chunker import hash_spans
+
+        problems: list[str] = []
+        batch: list[tuple[str, bytes]] = []
+        batch_bytes = 0
+
+        def flush():
+            nonlocal batch, batch_bytes
+            if not batch:
+                return
+            pieces: list[bytes] = []
+            spans = []
+            off = 0
+            for _, data in batch:
+                spans.append((off, len(data)))
+                pieces.append(data)
+                pad = -len(data) % 4096
+                if pad:
+                    pieces.append(bytes(pad))
+                off += len(data) + pad
+            got = hash_spans(b"".join(pieces), spans)
+            for (bid, _), digest in zip(batch, got):
+                if digest != bid:
+                    problems.append(
+                        f"blob {bid}: content hash mismatch ({digest})")
+            batch, batch_bytes = [], 0
+
+        def read_raw(bid: str):
+            try:
+                with self._lock:
+                    entry = self._entry(bid)
+                if entry is None:
+                    raise RepoError("not in index")
+                return bid, self._read_packed(bid, entry, verify=False)
+            except Exception as ex:  # noqa: BLE001 — report, don't die
+                return bid, ex
+
+        with ThreadPoolExecutor(max(workers, 1)) as pool:
+            for bid, data in pool.map(read_raw, blob_ids):
+                if isinstance(data, Exception):
+                    problems.append(f"blob {bid}: {data}")
+                    continue
+                batch.append((bid, data))
+                batch_bytes += len(data)
+                if batch_bytes >= self._DEVICE_VERIFY_BATCH:
+                    flush()
+        flush()
+        return problems
+
     def check(self, read_data: bool = False, *,
-              workers: int = 4) -> list[str]:
+              workers: int = 4,
+              device_verify: Optional[bool] = None) -> list[str]:
         """Structural check (restic ``check``): every indexed blob's pack
         exists; every blob reachable from any snapshot (sub-trees and
         file content included) is present in the index; with read_data,
         every indexed blob decrypts and re-hashes to its id (``workers``
         blobs verified concurrently — store IO + decrypt overlap;
-        read_blob and the zstd path are thread-safe)."""
+        read_blob and the zstd path are thread-safe).
+
+        ``device_verify`` (default: env VOLSYNC_DEVICE_VERIFY) re-hashes
+        the read blobs in DEVICE batches instead of per-blob host SHA —
+        decrypt/decompress stay on host, but the per-byte hashing rides
+        the page-grid kernel (engine/chunker.hash_spans), so a full
+        1 TiB verify is bounded by store IO + decompress, not hashlib."""
         problems = []
         with self._lock:
             entries = self._index.copy()  # three array copies, no objects
@@ -764,7 +834,15 @@ class Repository:
                 continue
             if read_data:
                 to_read.append(blob_id)
-        if to_read:
+        if device_verify is None:
+            import os as _os
+
+            device_verify = _os.environ.get(
+                "VOLSYNC_DEVICE_VERIFY", "").lower() not in (
+                "", "0", "false", "no")
+        if to_read and device_verify:
+            problems.extend(self._verify_blobs_device(to_read, workers))
+        elif to_read:
             def verify(blob_id: str):
                 try:
                     self.read_blob(blob_id)
