@@ -288,3 +288,43 @@ def test_check_device_verify_matches_host(monkeypatch):
     host = repo.check(read_data=True, device_verify=False)
     assert len(dev) == len(host) == 1
     assert dev[0].split(":")[0] == host[0].split(":")[0]  # same blob
+
+
+def test_restore_device_verified(tmp_path, monkeypatch):
+    """VOLSYNC_DEVICE_VERIFY=1 restore: bytes land only after their
+    device-verified batch; a corrupted pack fails the restore with an
+    integrity error, same as the host path."""
+    import numpy as np
+
+    from volsync_tpu.engine import TreeBackup, restore_snapshot
+    from volsync_tpu.repo import crypto
+
+    store = MemObjectStore()
+    repo = Repository.init(store, chunker=SMALL_CHUNKER)
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.RandomState(4)
+    payloads = {f"f{i}.bin": rng.bytes(60_000 + i * 999) for i in range(4)}
+    for name, data in payloads.items():
+        (src / name).write_bytes(data)
+    TreeBackup(repo).run(src)
+
+    monkeypatch.setenv("VOLSYNC_DEVICE_VERIFY", "1")
+    dst = tmp_path / "dst"
+    dst.mkdir()
+    restore_snapshot(repo, dst)
+    for name, data in payloads.items():
+        assert (dst / name).read_bytes() == data
+
+    # corrupt one pack: the device-verified restore must refuse
+    pack_key = next(k for k in store.list("data/"))
+    blob = bytearray(store.get(pack_key))
+    blob[50] ^= 0xFF
+    store.put(pack_key, bytes(blob))
+    repo.load_index()
+    dst2 = tmp_path / "dst2"
+    dst2.mkdir()
+    import pytest as _pytest
+
+    with _pytest.raises(crypto.IntegrityError):
+        restore_snapshot(repo, dst2)

@@ -507,6 +507,29 @@ def _open_readahead(path, segment_size: int):
     return open(path, "rb")
 
 
+def verify_blob_batch(pairs: list) -> list:
+    """Device-batch blob-id verification: ``pairs`` is
+    [(expected-id-hex, plaintext bytes)]; returns the ids whose content
+    re-derives to something else. One fused dispatch per call (blobs
+    pack page-aligned — hash_spans' fast path); decrypt/decompress
+    stay with the caller, only the per-byte hashing rides the device.
+    Shared by Repository.check's device path and TreeRestore."""
+    if not pairs:
+        return []
+    pieces: list[bytes] = []
+    spans = []
+    off = 0
+    for _, data in pairs:
+        spans.append((off, len(data)))
+        pieces.append(data)
+        pad = -len(data) % blobid.LEAF_SIZE
+        if pad:
+            pieces.append(bytes(pad))
+        off += len(data) + pad
+    got = hash_spans(b"".join(pieces), spans)
+    return [bid for (bid, _), d in zip(pairs, got) if d != bid]
+
+
 def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
     """Blob id of an arbitrarily large file with bounded memory: leaf
     digests are computed on device one ~32 MiB segment at a time and the

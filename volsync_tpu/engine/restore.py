@@ -30,6 +30,14 @@ class TreeRestore:
         if workers is None:
             workers = int(os.environ.get("VOLSYNC_RESTORE_WORKERS", "4"))
         self.workers = max(1, workers)
+        # Device-batched blob verification (same knob as repository
+        # check): per-byte re-hashing rides the page-grid kernel in
+        # ~64 MiB batches, host keeps only decrypt/decompress. Batches
+        # verify BEFORE their bytes are written, so corruption is
+        # caught exactly as early as the host path would.
+        self.device_verify = os.environ.get(
+            "VOLSYNC_DEVICE_VERIFY", "").lower() not in (
+            "", "0", "false", "no")
 
     def run(self, snap_id: str, manifest: dict, dest,
             *, delete_extra: bool = True) -> dict:
@@ -108,11 +116,44 @@ class TreeRestore:
         if target.is_symlink() or target.is_dir():
             _rmtree(target)
         with open(target, "wb") as f:
-            for blob_id in entry["content"]:
-                f.write(self.repo.read_blob(blob_id))
+            if self.device_verify:
+                self._write_device_verified(f, entry["content"])
+            else:
+                for blob_id in entry["content"]:
+                    f.write(self.repo.read_blob(blob_id))
         os.chmod(target, entry["mode"])
         os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
         return "files", entry["size"]
+
+    _VERIFY_BATCH = 64 * 1024 * 1024
+
+    def _write_device_verified(self, f, content: list):
+        """Raw blob reads in ~64 MiB groups, ONE device dispatch
+        re-derives the group's blob ids, bytes hit the file only after
+        their group verifies (engine/chunker.verify_blob_batch)."""
+        from volsync_tpu.engine.chunker import verify_blob_batch
+        from volsync_tpu.repo import crypto
+
+        group: list[tuple[str, bytes]] = []
+        gbytes = 0
+
+        def flush():
+            nonlocal group, gbytes
+            bad = verify_blob_batch(group)
+            if bad:
+                raise crypto.IntegrityError(
+                    f"restore: blob {bad[0]} content hash mismatch")
+            for _, data in group:
+                f.write(data)
+            group, gbytes = [], 0
+
+        for blob_id in content:
+            data = self.repo.read_blob_raw(blob_id)
+            group.append((blob_id, data))
+            gbytes += len(data)
+            if gbytes >= self._VERIFY_BATCH:
+                flush()
+        flush()
 
 
 def _rmtree(path: Path):

@@ -463,6 +463,22 @@ class Repository:
                 raise RepoError(f"blob {blob_id} buffered but missing")
         return self._read_packed(blob_id, entry)
 
+    def read_blob_raw(self, blob_id: str) -> bytes:
+        """read_blob WITHOUT the host re-hash. Callers MUST verify the
+        returned plaintext themselves (device-batched via
+        engine/chunker.verify_blob_batch) — this exists so bulk readers
+        can move the per-byte hashing off the host."""
+        with self._lock:
+            entry = self._entry(blob_id)
+            if entry is None:
+                raise RepoError(f"blob {blob_id} not in index")
+            if entry.pack == "":  # still buffered in the open pack
+                for e, seg in zip(self._cur_entries, self._cur_segments):
+                    if e["id"] == blob_id:
+                        return self._decode_blob(seg)
+                raise RepoError(f"blob {blob_id} buffered but missing")
+        return self._read_packed(blob_id, entry, verify=False)
+
     def _read_packed(self, blob_id: str, entry: IndexEntry, *,
                      verify: bool = True) -> bytes:
         """Fetch + decode (+ host-verify) a flushed blob WITHOUT
@@ -752,7 +768,7 @@ class Repository:
         (engine/chunker.hash_spans — the rclone checksum primitive)."""
         from concurrent.futures import ThreadPoolExecutor
 
-        from volsync_tpu.engine.chunker import hash_spans
+        from volsync_tpu.engine.chunker import verify_blob_batch
 
         problems: list[str] = []
         batch: list[tuple[str, bytes]] = []
@@ -760,23 +776,8 @@ class Repository:
 
         def flush():
             nonlocal batch, batch_bytes
-            if not batch:
-                return
-            pieces: list[bytes] = []
-            spans = []
-            off = 0
-            for _, data in batch:
-                spans.append((off, len(data)))
-                pieces.append(data)
-                pad = -len(data) % 4096
-                if pad:
-                    pieces.append(bytes(pad))
-                off += len(data) + pad
-            got = hash_spans(b"".join(pieces), spans)
-            for (bid, _), digest in zip(batch, got):
-                if digest != bid:
-                    problems.append(
-                        f"blob {bid}: content hash mismatch ({digest})")
+            for bid in verify_blob_batch(batch):
+                problems.append(f"blob {bid}: content hash mismatch")
             batch, batch_bytes = [], 0
 
         def read_raw(bid: str):
