@@ -621,6 +621,9 @@ def main():
                                  CPU_MEASURE_TIMEOUT_S)
     if out is not None:
         out["backend"] = "cpu-fallback"
+        out["note"] = ("TPU backend unreachable at bench time; this is "
+                       "the labeled CPU-backend fallback, not an "
+                       "accelerator number (see docs/round4.md)")
         _emit(out)
         return 0
     _log("bench: every measurement path failed")
