@@ -1,0 +1,113 @@
+"""Stage-by-stage timing of the fused segment pipeline on the live chip.
+
+Times each device stage in isolation (block_until_ready between
+dispatches) and the end-to-end shipped protocol, to locate the
+bottleneck: gear scan, page SHA-256, transpose, FastCDC walk, root
+loop, or the host round trip. Run on the TPU; not part of the test
+suite.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), "..",
+                                   ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from volsync_tpu.ops import segment as seg
+from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS, gear_at_aligned
+from volsync_tpu.ops import sha256 as sha
+
+p = DEFAULT_PARAMS
+SEG_MIB = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+N = SEG_MIB * 1024 * 1024
+ITERS = 5
+
+rng = np.random.RandomState(7)
+host = rng.randint(0, 256, size=(N,), dtype=np.uint8)
+data = jnp.asarray(host)
+jax.block_until_ready(data)
+cand_cap, chunk_cap = seg.segment_caps(N, p)
+F = N // seg.LEAF_SIZE
+npp = seg._n_pages_pad(F)
+
+
+def timeit(name, fn, *args, iters=ITERS, scale_bytes=N):
+    out = fn(*args)
+    jax.block_until_ready(out)  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt*1e3:8.2f} ms  {scale_bytes/dt/(1<<30):7.2f} GiB/s",
+          flush=True)
+    return dt
+
+
+print(f"== segment {SEG_MIB} MiB, backend={jax.default_backend()}, "
+      f"pallas={sha.use_pallas_leaves()}, npp={npp}", flush=True)
+
+# 1. gear scan only
+gear_j = jax.jit(lambda d: gear_at_aligned(d, p.seed, p.align))
+timeit("gear_at_aligned", gear_j, data)
+
+# 2. page digests (pack + transpose + sha kernel)
+pd = jax.jit(lambda d: seg._page_digests_flat(d, npp))
+timeit("page_digests_flat (full)", pd, data)
+
+# 2a. word pack only
+def pack_only(d):
+    r = d.reshape(F, seg.LEAF_SIZE)
+    b0 = r[:, 0::4].astype(jnp.uint32)
+    b1 = r[:, 1::4].astype(jnp.uint32)
+    b2 = r[:, 2::4].astype(jnp.uint32)
+    b3 = r[:, 3::4].astype(jnp.uint32)
+    return ((b0 << np.uint32(24)) | (b1 << np.uint32(16))
+            | (b2 << np.uint32(8)) | b3)
+pack_j = jax.jit(pack_only)
+timeit("  word pack", pack_j, data)
+
+# 2b. pack + transpose
+def pack_t(d):
+    x2 = pack_only(d)
+    if npp != F:
+        x2 = jnp.pad(x2, ((0, npp - F), (0, 0)))
+    return seg._pallas_transpose(x2)
+packt_j = jax.jit(pack_t)
+timeit("  pack + pallas transpose", packt_j, data)
+
+# 3. full fused program (device only, no fetch)
+def fused(d):
+    return seg.chunk_hash_segment(
+        d, N, min_size=p.min_size, avg_size=p.avg_size,
+        max_size=p.max_size, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+        align=p.align, eof=True, cand_cap=cand_cap, chunk_cap=chunk_cap)
+timeit("chunk_hash_segment (no fetch)", fused, data)
+
+# 4. end-to-end with fetch (the shipped protocol)
+def fused_fetch(d):
+    return np.asarray(fused(d))
+out = fused_fetch(data)
+t0 = time.perf_counter()
+for _ in range(ITERS):
+    fused_fetch(data)
+dt = (time.perf_counter() - t0) / ITERS
+print(f"{'chunk_hash_segment + fetch':34s} {dt*1e3:8.2f} ms  "
+      f"{N/dt/(1<<30):7.2f} GiB/s", flush=True)
+
+# 5. dispatch round-trip floor (tiny program + tiny fetch)
+tiny = jax.jit(lambda v: (v * 2 + 1).sum())
+x = jnp.arange(64, dtype=jnp.float32)
+jax.block_until_ready(tiny(x))
+t0 = time.perf_counter()
+for _ in range(20):
+    float(tiny(x))
+rt = (time.perf_counter() - t0) / 20
+print(f"{'dispatch+fetch round trip':34s} {rt*1e3:8.2f} ms", flush=True)

@@ -1,0 +1,87 @@
+"""Fenced, salted stage split of the fused program: full pipeline vs
+page digests vs gear+walk vs root loop. Same methodology as
+tune_sha.py (scalar-fetch fence, per-iteration salts)."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), "..",
+                                   ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from volsync_tpu.ops import segment as seg
+from volsync_tpu.ops import sha256 as sha
+from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS, gear_at_aligned
+
+p = DEFAULT_PARAMS
+SEG_MIB = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+N = SEG_MIB << 20
+F = N // 4096
+ITERS = 12
+
+rng = np.random.RandomState(7)
+host = rng.randint(0, 256, size=(N,), dtype=np.uint8)
+base = jnp.asarray(host)
+jax.block_until_ready(base)
+cand_cap, chunk_cap = seg.segment_caps(N, p)
+npp = seg._n_pages_pad(F)
+
+
+@jax.jit
+def full(d, s):
+    out = seg.chunk_hash_segment(
+        d ^ s, N, min_size=p.min_size, avg_size=p.avg_size,
+        max_size=p.max_size, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+        align=p.align, eof=True, cand_cap=cand_cap, chunk_cap=chunk_cap)
+    return out.astype(jnp.uint32)[::97].sum()
+
+
+@jax.jit
+def pages_only(d, s):
+    return seg._page_digests_flat(d ^ s, npp)[::4097].sum()
+
+
+@jax.jit
+def gear_walk_only(d, s):
+    d = d ^ s
+    h = gear_at_aligned(d, p.seed, p.align)
+    R = N // p.align
+    pos_all = jnp.arange(R, dtype=jnp.int32) * p.align + (p.align - 1)
+    ok = pos_all < N
+    is_s = ((h & np.uint32(p.mask_s)) == 0) & ok
+    is_l = ((h & np.uint32(p.mask_l)) == 0) & ok
+    pos_s = seg._compact_candidates(is_s, cand_cap, R, p.align)
+    pos_l = seg._compact_candidates(is_l, cand_cap, R, p.align)
+    ns = jnp.sum(is_s).astype(jnp.int32)
+    nl = jnp.sum(is_l).astype(jnp.int32)
+    starts, lens, count, consumed = seg._select_boundaries_device(
+        pos_s, jnp.minimum(ns, cand_cap), pos_l, jnp.minimum(nl, cand_cap),
+        jnp.int32(N), min_size=p.min_size, avg_size=p.avg_size,
+        max_size=p.max_size, chunk_cap=chunk_cap, eof=True)
+    return starts.sum() + lens.sum() + count + consumed
+
+
+def timeit(name, fn):
+    float(fn(base, jnp.uint8(0)))
+    t0 = time.perf_counter()
+    out = None
+    for i in range(ITERS):
+        out = fn(base, jnp.uint8(i + 1))
+    float(out)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:28s} {dt * 1e3:8.2f} ms  {N / dt / (1 << 30):7.2f} GiB/s",
+          flush=True)
+
+
+print(f"== {SEG_MIB} MiB fused split, backend={jax.default_backend()}",
+      flush=True)
+timeit("full fused program", full)
+timeit("page digests only", pages_only)
+timeit("gear + walk only", gear_walk_only)

@@ -1,0 +1,136 @@
+"""Finest-grain stage isolation of the fused program on the live chip.
+Salted + scalar-fetch fenced (see tune_sha.py)."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), "..",
+                                   ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from volsync_tpu.ops import segment as seg
+from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS, gear_at_aligned
+
+p = DEFAULT_PARAMS
+SEG_MIB = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+N = SEG_MIB << 20
+F = N // 4096
+R = N // p.align
+ITERS = 12
+
+rng = np.random.RandomState(7)
+host = rng.randint(0, 256, size=(N,), dtype=np.uint8)
+base = jnp.asarray(host)
+jax.block_until_ready(base)
+cand_cap, chunk_cap = seg.segment_caps(N, p)
+npp = seg._n_pages_pad(F)
+
+
+def candidates(d):
+    h = gear_at_aligned(d, p.seed, p.align)
+    pos_all = jnp.arange(R, dtype=jnp.int32) * p.align + (p.align - 1)
+    ok = pos_all < N
+    is_s = ((h & np.uint32(p.mask_s)) == 0) & ok
+    is_l = ((h & np.uint32(p.mask_l)) == 0) & ok
+    return is_s, is_l
+
+
+@jax.jit
+def gear_only(d, s):
+    h = gear_at_aligned(d ^ s, p.seed, p.align)
+    return h.astype(jnp.uint32).sum()
+
+
+@jax.jit
+def gear_compact(d, s):
+    is_s, is_l = candidates(d ^ s)
+    pos_s = seg._compact_candidates(is_s, cand_cap, R, p.align)
+    pos_l = seg._compact_candidates(is_l, cand_cap, R, p.align)
+    return pos_s.sum() + pos_l.sum()
+
+
+def tables(pos_s, ns, pos_l, nl):
+    i32 = jnp.int32
+    L = jnp.int32(N)
+    pos_r = jnp.arange(R, dtype=i32) * p.align
+    lo = pos_r + (p.min_size - 1)
+    mid = pos_r + (p.avg_size - 1)
+    hi = pos_r + (p.max_size - 1)
+    i = jnp.searchsorted(pos_s, lo, side="left").astype(i32)
+    cs = pos_s[jnp.clip(i, 0, cand_cap - 1)]
+    lim_s = jnp.minimum(jnp.minimum(mid - 1, L - 1), hi)
+    found_s = (i < ns) & (cs <= lim_s)
+    j = jnp.searchsorted(pos_l, jnp.maximum(lo, mid),
+                         side="left").astype(i32)
+    cl = pos_l[jnp.clip(j, 0, cand_cap - 1)]
+    found_l = (j < nl) & (cl <= jnp.minimum(hi, L - 1))
+    hi_ok = hi <= L - 1
+    cut = jnp.where(found_s, cs,
+                    jnp.where(found_l, cl,
+                              jnp.where(hi_ok, hi, L - 1)))
+    emit = found_s | found_l | hi_ok
+    return cut, emit
+
+
+@jax.jit
+def gear_compact_tables(d, s):
+    is_s, is_l = candidates(d ^ s)
+    pos_s = seg._compact_candidates(is_s, cand_cap, R, p.align)
+    pos_l = seg._compact_candidates(is_l, cand_cap, R, p.align)
+    ns = jnp.sum(is_s).astype(jnp.int32)
+    nl = jnp.sum(is_l).astype(jnp.int32)
+    cut, emit = tables(pos_s, ns, pos_l, nl)
+    return cut.sum() + emit.sum()
+
+
+@jax.jit
+def gear_walk(d, s):
+    is_s, is_l = candidates(d ^ s)
+    pos_s = seg._compact_candidates(is_s, cand_cap, R, p.align)
+    pos_l = seg._compact_candidates(is_l, cand_cap, R, p.align)
+    ns = jnp.sum(is_s).astype(jnp.int32)
+    nl = jnp.sum(is_l).astype(jnp.int32)
+    starts, lens, count, consumed = seg._select_boundaries_device(
+        pos_s, jnp.minimum(ns, cand_cap), pos_l, jnp.minimum(nl, cand_cap),
+        jnp.int32(N), min_size=p.min_size, avg_size=p.avg_size,
+        max_size=p.max_size, chunk_cap=chunk_cap, eof=True,
+        align=p.align, n_rows=R)
+    return starts.sum() + lens.sum() + count + consumed
+
+
+@jax.jit
+def full(d, s):
+    out = seg.chunk_hash_segment(
+        d ^ s, N, min_size=p.min_size, avg_size=p.avg_size,
+        max_size=p.max_size, seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l,
+        align=p.align, eof=True, cand_cap=cand_cap, chunk_cap=chunk_cap)
+    return out.astype(jnp.uint32)[::97].sum()
+
+
+def timeit(name, fn):
+    float(fn(base, jnp.uint8(0)))
+    t0 = time.perf_counter()
+    out = None
+    for i in range(ITERS):
+        out = fn(base, jnp.uint8(i + 1))
+    float(out)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:28s} {dt * 1e3:8.2f} ms  {N / dt / (1 << 30):7.2f} GiB/s",
+          flush=True)
+
+
+print(f"== {SEG_MIB} MiB fine split, backend={jax.default_backend()}, "
+      f"root_unroll={os.environ.get('VOLSYNC_ROOT_UNROLL', '4')}",
+      flush=True)
+timeit("gear only", gear_only)
+timeit("gear + compaction", gear_compact)
+timeit("gear + compact + tables", gear_compact_tables)
+timeit("gear + compact + walk", gear_walk)
+timeit("full fused", full)

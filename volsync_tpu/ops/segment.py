@@ -109,34 +109,45 @@ def _apply_tail_overrides(flat: jax.Array, n_pages_pad: int,
 
 def _select_boundaries_device(pos_s, ns, pos_l, nl, valid_len, *,
                               min_size: int, avg_size: int, max_size: int,
-                              chunk_cap: int, eof: bool):
-    """lax.while_loop FastCDC walk == gearcdc._select_boundaries_py.
+                              chunk_cap: int, eof: bool,
+                              align: int = 0, n_rows: int = 0):
+    """FastCDC walk == gearcdc._select_boundaries_py, successor-table
+    form.
 
     pos_s/pos_l: sorted compacted candidate cut positions (padded with a
     sentinel greater than any valid position); ns/nl their true counts.
     Returns (starts[chunk_cap], lens[chunk_cap], count, consumed).
+
+    With the page-aligned format every reachable chunk start is a
+    multiple of ``align`` (cuts are ≡ align-1 mod align; the max_size
+    fallback advances by a page multiple), so the cut decision is a pure
+    function of the start ROW. The cut/emit tables for ALL ``n_rows``
+    possible starts are precomputed with two BATCHED searchsorted calls
+    (one vector op each), and the sequential walk degrades to a
+    per-step table gather. Measured on v5e (64 MiB): ~6 ms of
+    per-iteration searchsorted pairs -> <1 ms. ``align``/``n_rows`` == 0
+    keeps the generic per-iteration form (callers without row
+    structure).
     """
     i32 = jnp.int32
     L = valid_len.astype(i32)
+    cap_s = pos_s.shape[0]
+    cap_l = pos_l.shape[0]
 
-    def cond(c):
-        pos, cnt, done, _, _ = c
-        return (~done) & (pos < L) & (cnt < chunk_cap)
-
-    def body(c):
-        pos, cnt, done, starts, lens = c
+    def cut_emit(pos):
+        """(cut, emit) of a chunk starting at ``pos`` — scalar in the
+        per-iteration form, [n_rows] in the table precompute. ONE home
+        for the FastCDC decision so the two forms cannot drift."""
         lo = pos + (min_size - 1)
         mid = pos + (avg_size - 1)
         hi = pos + (max_size - 1)
-        # First strict candidate in [lo, min(mid-1, L-1, hi)].
         i = jnp.searchsorted(pos_s, lo, side="left").astype(i32)
-        cs = pos_s[jnp.clip(i, 0, pos_s.shape[0] - 1)]
+        cs = pos_s[jnp.clip(i, 0, cap_s - 1)]
         lim_s = jnp.minimum(jnp.minimum(mid - 1, L - 1), hi)
         found_s = (i < ns) & (cs <= lim_s)
-        # Else first lax candidate in [max(lo, mid), min(hi, L-1)].
         j = jnp.searchsorted(pos_l, jnp.maximum(lo, mid),
                              side="left").astype(i32)
-        cl = pos_l[jnp.clip(j, 0, pos_l.shape[0] - 1)]
+        cl = pos_l[jnp.clip(j, 0, cap_l - 1)]
         found_l = (j < nl) & (cl <= jnp.minimum(hi, L - 1))
         hi_ok = hi <= L - 1
         cut = jnp.where(found_s, cs,
@@ -145,6 +156,29 @@ def _select_boundaries_device(pos_s, ns, pos_l, nl, valid_len, *,
         # eof may be a static Python bool (single-segment path, part of
         # the jit cache key) OR a traced per-lane scalar (batched path).
         emit = found_s | found_l | hi_ok | jnp.asarray(eof, jnp.bool_)
+        return cut, emit
+
+    use_table = (align > 0 and (align & (align - 1)) == 0 and n_rows > 0
+                 and min_size % align == 0 and max_size % align == 0
+                 and avg_size % align == 0)
+    if use_table:
+        # Successor tables over every possible start row: two BATCHED
+        # searchsorted calls replace a searchsorted pair per iteration.
+        cut_tab, emit_tab = cut_emit(jnp.arange(n_rows, dtype=i32) * align)
+        shift = int(align).bit_length() - 1
+
+    def cond(c):
+        pos, cnt, done, _, _ = c
+        return (~done) & (pos < L) & (cnt < chunk_cap)
+
+    def body(c):
+        pos, cnt, done, starts, lens = c
+        if use_table:
+            r = jnp.clip(pos >> shift, 0, n_rows - 1)
+            cut = cut_tab[r]
+            emit = emit_tab[r]
+        else:
+            cut, emit = cut_emit(pos)
         # Predicated append: drop the write when not emitting.
         wr = jnp.where(emit, cnt, chunk_cap)
         starts = starts.at[wr].set(pos, mode="drop")
@@ -297,30 +331,48 @@ def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live,
     if word_index is None:
         def word_index(j, p):
             return j * Fp + p
-    jj = jnp.arange(17, dtype=jnp.int32)[None, :]  # D indices n*16-4+j
+    # U message blocks per while iteration: ONE [C_cap, 16U+1] gather
+    # covers all U sub-blocks (each needs D words m*16-4+j, j<=16 — the
+    # sub-slices overlap by one word), so the loop pays the gather and
+    # loop-carry overhead once per U compressions. The compressions
+    # themselves chain (SHA is sequential per lane) — U trades overhead,
+    # not parallelism.
+    # Tuning knob for profiling runs only: read at TRACE time and not
+    # part of any jit cache key, so it must be set before the first
+    # compile of a shape in a fresh process. Clamped: U < 1 would make
+    # the loop body a no-op that never advances n (device hang).
+    import os as _os
+    U = max(1, int(_os.environ.get("VOLSYNC_ROOT_UNROLL", "4")))
+    jj = jnp.arange(16 * U + 1, dtype=jnp.int32)[None, :]
+    q16 = jnp.arange(16, dtype=jnp.int32)[None, :]
 
     def cond(c):
         return c[0] < max_nb
 
     def body(c):
         n, state = c
-        t = n * 16 - 4 + jj  # [1,17] broadcast over lanes
+        t = n * 16 - 4 + jj  # [1, 16U+1] broadcast over lanes
         tc = jnp.clip(t, 0, Fp * 8 - 1)
         idx = word_index(tc % 8, page0[:, None] + tc // 8)
-        d = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]  # [C_cap, 17]
+        d = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]  # [C_cap, 16U+1]
         d = jnp.where((t >= 0) & (t < nl8[:, None]), d, jnp.uint32(0))
-        blk = (d[:, :16] << jnp.uint32(24)) | (d[:, 1:] >> jnp.uint32(8))
-        q = n * 16 + jnp.arange(16, dtype=jnp.int32)[None, :]  # [1,16]
-        blk = jnp.where(q == 0, jnp.uint32(_DOMAIN_WORD0), blk)
-        blk = jnp.where(q == 1, w1[:, None], blk)
-        blk = jnp.where(q == 2, w2[:, None], blk)
-        blk = jnp.where(q == 3, d[:, 4:5] >> jnp.uint32(8), blk)
-        blk = jnp.where(q == qterm[:, None],
-                        blk | jnp.uint32(0x00800000), blk)
-        blk = jnp.where(q == qlen[:, None], bitlen[:, None], blk)
-        new = _compress(state, blk)
-        keep = (n < nb)[:, None]
-        return n + 1, jnp.where(keep, new, state)
+        for u in range(U):
+            m = n + u
+            du = d[:, 16 * u: 16 * u + 17]  # this sub-block's 17 words
+            blk = (du[:, :16] << jnp.uint32(24)) \
+                | (du[:, 1:] >> jnp.uint32(8))
+            q = m * 16 + q16  # [1,16]
+            blk = jnp.where(q == 0, jnp.uint32(_DOMAIN_WORD0), blk)
+            blk = jnp.where(q == 1, w1[:, None], blk)
+            blk = jnp.where(q == 2, w2[:, None], blk)
+            blk = jnp.where(q == 3, du[:, 4:5] >> jnp.uint32(8), blk)
+            blk = jnp.where(q == qterm[:, None],
+                            blk | jnp.uint32(0x00800000), blk)
+            blk = jnp.where(q == qlen[:, None], bitlen[:, None], blk)
+            new = _compress(state, blk)
+            keep = (m < nb)[:, None]
+            state = jnp.where(keep, new, state)
+        return n + U, state
 
     state0 = jnp.broadcast_to(jnp.asarray(_H0), (C_cap, 8))
     _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state0))
@@ -366,7 +418,7 @@ def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
     starts, lens, count, consumed = _select_boundaries_device(
         pos_s, jnp.minimum(ns, cand_cap), pos_l, jnp.minimum(nl, cand_cap),
         valid_len, min_size=min_size, avg_size=avg_size, max_size=max_size,
-        chunk_cap=chunk_cap, eof=eof)
+        chunk_cap=chunk_cap, eof=eof, align=align, n_rows=R)
 
     # --- page digests (all full leaves are pages; no gather)
     flat = _page_digests_flat(data, n_pages_pad)
@@ -459,7 +511,7 @@ def chunk_hash_segments(data: jax.Array, valid_len: jax.Array,
         return _select_boundaries_device(
             ps, jnp.minimum(n_s, cand_cap), plx, jnp.minimum(n_l, cand_cap),
             vl, min_size=min_size, avg_size=avg_size, max_size=max_size,
-            chunk_cap=chunk_cap, eof=e)
+            chunk_cap=chunk_cap, eof=e, align=align, n_rows=R)
 
     starts, lens, count, consumed = jax.vmap(walk)(pos_s, ns, pos_l, nl,
                                                    valid_len, eof)

@@ -87,10 +87,10 @@ def _compress_unrolled(state: jax.Array, block: jax.Array) -> jax.Array:
     a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
     for t in range(64):
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
+        ch = g ^ (e & (f ^ g))  # == (e&f)^(~e&g), one op fewer
         t1 = h + s1 + ch + _K[t] + w[t]
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
+        maj = (a & (b | c)) | (b & c)  # == (a&b)^(a&c)^(b&c)
         t2 = s0 + maj
         h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
     out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
@@ -110,10 +110,10 @@ def _compress_scan(state: jax.Array, block: jax.Array) -> jax.Array:
         (a, b, c, d, e, f, g, h), w = carry
         wt = w[0]
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
+        ch = g ^ (e & (f ^ g))
         t1 = h + s1 + ch + K[t] + wt
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
+        maj = (a & (b | c)) | (b & c)
         t2 = s0 + maj
         state_new = (t1 + t2, a, b, c, d + t1, e, f, g)
         # Extend the schedule: w[t+16] from the window (FIPS 180-4 §6.2.2).
@@ -356,10 +356,10 @@ def _round64_p(state, w):
             wt = w[r - 16] + s0 + w[r - 7] + s1
             w.append(wt)
         S1 = _rotr_p(e, 6) ^ _rotr_p(e, 11) ^ _rotr_p(e, 25)
-        ch = (e & f) ^ (~e & g)
+        ch = g ^ (e & (f ^ g))  # == (e&f)^(~e&g), one op fewer
         t1 = h + S1 + ch + np.uint32(_K[r]) + wt
         S0 = _rotr_p(a, 2) ^ _rotr_p(a, 13) ^ _rotr_p(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
+        maj = (a & (b | c)) | (b & c)  # == (a&b)^(a&c)^(b&c)
         h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + S0 + maj
     return tuple(x + y for x, y in zip(state, (a, b, c, d, e, f, g, h)))
 

@@ -353,12 +353,15 @@ def _build_fused_fn(mesh, params: GearParams, shard_len: int,
         ns = jax.lax.psum(jnp.sum(is_s).astype(jnp.int32), SEQ)
         worst = jax.lax.pmax(jnp.sum(is_l).astype(jnp.int32), SEQ)
 
-        # --- replicated FastCDC walk
+        # --- replicated FastCDC walk (global positions are multiples of
+        # align too, so the successor-table fast form applies with the
+        # GLOBAL row count S*R)
         starts, lens, count, consumed = _select_boundaries_device(
             pos_s, jnp.minimum(ns, S * cand_cap),
             pos_l, jnp.minimum(nl, S * cand_cap),
             valid_len, min_size=p.min_size, avg_size=p.avg_size,
-            max_size=p.max_size, chunk_cap=chunk_cap, eof=eof)
+            max_size=p.max_size, chunk_cap=chunk_cap, eof=eof,
+            align=align, n_rows=S * R)
 
         # --- the ONE possibly-partial tail leaf: hashed by its owner
         # shard, psum-broadcast, spliced into the gathered table.
