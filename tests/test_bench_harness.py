@@ -82,3 +82,24 @@ def test_config_deadline_scales_for_cpu(monkeypatch):
     assert bench._config_deadline_s() == bench.CPU_CONFIG_DEADLINE_S
     monkeypatch.delenv("VOLSYNC_BENCH_CPU_FALLBACK")
     assert bench._config_deadline_s() == bench.CONFIG_DEADLINE_S
+
+def test_parse_config():
+    assert bench._parse_config("64,8,6") == ("S", 64, 8, 6)
+    assert bench._parse_config("S64,8,6") == ("S", 64, 8, 6)
+    assert bench._parse_config("B:128,8,4") == ("B", 128, 8, 4)
+    assert bench._parse_config("B32,8,8") == ("B", 32, 8, 8)
+
+
+def test_batched_throughput_golden_path():
+    """Drive _try_batched_throughput end-to-end on the CPU backend at a
+    tiny shape: exercises the batched dispatch, the on-TPU-style golden
+    check against the host reference, and the pipelined thread pool."""
+    out = bench._try_batched_throughput(2, 2, 1, pipelines=2)
+    assert out > 0
+
+
+def test_device_throughput_golden_path():
+    """Same for the single-segment path (its golden warm check runs the
+    full host-reference comparison)."""
+    out = bench._try_device_throughput(2, 1, 1)
+    assert out > 0
