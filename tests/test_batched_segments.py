@@ -197,3 +197,30 @@ def test_treebackup_with_shared_batcher(tmp_path, monkeypatch):
             (src / f"f{i}.bin").read_bytes()
     # concurrency actually coalesced
     assert batch_sizes and any(s > 1 for s in batch_sizes), batch_sizes
+
+
+def test_microbatcher_pipelined_concurrent_submits(rng):
+    """Many concurrent producers through a pipeline_depth=2 batcher:
+    every caller gets ITS lane's result (no cross-batch mixups while
+    two dispatches are in flight), identical to the single driver."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from volsync_tpu.engine.chunker import DeviceChunkHasher
+    from volsync_tpu.ops.batcher import SegmentMicroBatcher
+
+    single = DeviceChunkHasher(P)
+    items = [rng.bytes(30_000 + 7 * i) for i in range(12)]
+    want = [single.process(np.frombuffer(b, np.uint8), eof=True)
+            for b in items]
+
+    mb = SegmentMicroBatcher(P, max_batch=3, window_ms=5.0,
+                             pipeline_depth=2)
+    try:
+        with ThreadPoolExecutor(6) as ex:
+            got = list(ex.map(
+                lambda b: mb.submit(b, len(b), True), items))
+    finally:
+        mb.stop()
+    for b, (chunks, consumed), w in zip(items, got, want):
+        assert chunks == w
+        assert consumed == len(b)

@@ -73,7 +73,8 @@ class MoverJaxServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None, params=None,
                  segment_size: int = DEFAULT_SEGMENT_SIZE,
-                 max_workers: int = 8, batch_window_ms: float = 2.0):
+                 max_workers: int = 8, batch_window_ms: float = 2.0,
+                 pipeline_depth: Optional[int] = None):
         from volsync_tpu.engine.chunker import DeviceChunkHasher
         from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS
 
@@ -87,9 +88,12 @@ class MoverJaxServer:
         self._hasher.use_shared_batcher = False
         self._batcher = None
         if batch_window_ms > 0 and self.params.align == 4096:
+            if pipeline_depth is None:
+                pipeline_depth = int(os.environ.get(
+                    "VOLSYNC_BATCH_PIPELINE", "2"))
             self._batcher = SegmentMicroBatcher(
                 self.params, window_ms=batch_window_ms,
-                max_batch=max_workers)
+                max_batch=max_workers, pipeline_depth=pipeline_depth)
 
         serialize = lambda m: m.SerializeToString()  # noqa: E731
         handlers = {
