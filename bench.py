@@ -33,8 +33,11 @@ whole budget dying in backend init):
     down to smaller configs; each config runs under a SIGALRM deadline.
   * A global watchdog thread guarantees one JSON line before the driver's
     timeout no matter what wedges.
-  * The persistent compilation cache is enabled so retries (and future
-    rounds) do not pay recompilation.
+  * The persistent compilation cache is enabled so CPU-path retries
+    (and future rounds) do not pay recompilation. NOTE: the serving
+    tunnel's remote-compile path bypasses the local cache, so TPU
+    configs pay their full compile inside the config deadline — the
+    ladder is ordered by known compile cost for exactly this reason.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
 diagnostics {"backend", "path", "config"}.
@@ -452,12 +455,17 @@ def _parse_config(s: str) -> tuple[str, int, int, int]:
 
 def _run_config_ladder() -> tuple[float, str]:
     # Primary metric: the cross-PVC batched program (shipped via the
-    # mover-jax coalescer and VOLSYNC_BATCH_SEGMENTS) at the largest
-    # bytes-per-dispatch that fits — measured r4: ~7 ms fixed execution
-    # overhead + ~80 ms result round trip per dispatch make
-    # bytes-per-dispatch, not kernel speed, the first-order term. The
-    # single-segment path is the fallback rung.
-    configs = [("B", 128, 8, 4), ("B", 64, 8, 6), ("B", 32, 8, 8),
+    # mover-jax coalescer and VOLSYNC_BATCH_SEGMENTS) — measured r4:
+    # ~7 ms fixed execution overhead + ~80 ms result round trip per
+    # dispatch make bytes-per-dispatch, not kernel speed, the
+    # first-order term. The first rung is the LARGEST shape with a
+    # known-bounded compile: remote compile bypasses the local
+    # persistent cache, compile time grows superlinearly with segment
+    # size (64 MiB ~40 s, 256 MiB >9 min, measured r4), and compile
+    # counts against the config deadline — bigger shapes belong to the
+    # upsize probes, which can deadline without losing the number in
+    # hand. The single-segment path is the fallback rung.
+    configs = [("B", 64, 8, 6), ("B", 32, 8, 8),
                ("S", 64, 8, 6), ("S", 32, 4, 4)]
     if os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
         # CPU-backend XLA scan is orders slower; tiny configs + the

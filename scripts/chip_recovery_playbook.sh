@@ -1,0 +1,42 @@
+#!/bin/bash
+# One-command playbook for when the TPU tunnel recovers (single-tenant:
+# run this ALONE — kill every other python first; see
+# docs/performance.md "Measured dispatch economics").
+#
+#   1. probe (hard-killed on hang; SIGTERM does not kill a client
+#      blocked in backend init)
+#   2. on-chip golden verify of the kernel surfaces (/tmp/verify_r4.py
+#      if present, else the bench's own golden checks cover it)
+#   3. bench rungs, serially, biggest-known-safe first — each run both
+#      measures and smoke-proves the shapes the driver's bench will use
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+fails=0
+
+probe() {
+  timeout -k 5 120 python -c "import jax; print('probe-ok', jax.devices())" 2>&1 | tail -1
+}
+
+echo "== probe"; out=$(probe)
+echo "$out"
+case "$out" in *probe-ok*) ;; *) echo "tunnel still wedged"; exit 75;; esac
+
+if [ -f /tmp/verify_r4.py ]; then
+  echo "== on-chip golden verify"
+  if ! timeout -k 5 900 python /tmp/verify_r4.py 2>&1 \
+      | { grep -v WARNING || true; } | tail -8; then
+    echo "GOLDEN VERIFY FAILED — do not bench these kernels"; exit 1
+  fi
+fi
+
+for cfg in "B:64,8,6" "B:128,8,3" "S:64,8,6"; do
+  echo "== bench rung $cfg"
+  if ! VOLSYNC_BENCH_CONFIG="$cfg" VOLSYNC_BENCH_INNER=1 \
+      VOLSYNC_BENCH_BUDGET_S=1100 VOLSYNC_BENCH_CONFIG_DEADLINE=900 \
+      timeout -k 5 1150 python bench.py 2>&1 \
+      | { grep -v WARNING || true; } | tail -3; then
+    echo "RUNG FAILED: $cfg"; fails=$((fails + 1))
+  fi
+done
+echo "== playbook done (failed rungs: $fails)"
+exit "$fails"
