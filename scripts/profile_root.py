@@ -88,8 +88,23 @@ flat0 = jnp.arange(8 * npp, dtype=jnp.uint32)  # synthetic digest table
 
 @jax.jit
 def root_only(fl, s):
-    st = seg._root_digests_loop(fl ^ s.astype(jnp.uint32), npp, page0,
-                                nleaves, lens_d, live)
+    # explicit word-major index: keep this row honest even when the
+    # VOLSYNC_PAGEMAJOR gate is set in the environment
+    st = seg._root_digests_loop(
+        fl ^ s.astype(jnp.uint32), npp, page0, nleaves, lens_d, live,
+        word_index=lambda j, p: j * npp + p)
+    return st.astype(jnp.uint32).sum()
+
+
+@jax.jit
+def root_pagemajor(fl, s):
+    """Same loop over a PAGE-major digest table (word j of page p at
+    p*8 + j): each lane's 65-word gather reads contiguous memory. If
+    this is much faster than the word-major layout, restructuring the
+    SHA kernel's output layout pays."""
+    st = seg._root_digests_loop(
+        fl ^ s.astype(jnp.uint32), npp, page0, nleaves, lens_d, live,
+        word_index=lambda j, p: p * 8 + j)
     return st.astype(jnp.uint32).sum()
 
 
@@ -97,4 +112,5 @@ print(f"== {SEG_MIB} MiB, backend={jax.default_backend()}, "
       f"U={os.environ.get('VOLSYNC_ROOT_UNROLL', '4')}", flush=True)
 timeit("full fused", full, base)
 timeit("pages only", pages, base)
-timeit("root only", root_only, flat0)
+timeit("root only (word-major)", root_only, flat0)
+timeit("root only (page-major)", root_pagemajor, flat0)

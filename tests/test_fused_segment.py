@@ -188,3 +188,40 @@ def test_hash_spans_overlapping_aligned_fallback(rng):
     got = hash_spans(buf, spans)
     for (s, l), d in zip(spans, got):
         assert d == blobid.blob_id(buf[s: s + l])
+
+
+def test_pagemajor_layout_bit_identical(rng, monkeypatch):
+    """VOLSYNC_PAGEMAJOR flips the digest-table layout (contiguous
+    per-page words for the root gather); the packed program result must
+    be bit-identical. Gate is read at trace time, so clear the jit
+    cache around the flip."""
+    import jax
+
+    from volsync_tpu.ops import segment as seg
+    from volsync_tpu.ops.gearcdc import GearParams
+
+    p = GearParams(min_size=4096, avg_size=32768, max_size=65536,
+                   seed=0xFEED, align=4096)
+    n = 192 * 1024
+    data = np.frombuffer(rng.bytes(n), np.uint8)
+    cc, kc = seg.segment_caps(n, p)
+
+    def run():
+        jax.clear_caches()
+        import jax.numpy as jnp
+        out = seg.chunk_hash_segment(
+            jnp.asarray(data), n - 333, min_size=p.min_size,
+            avg_size=p.avg_size, max_size=p.max_size, seed=p.seed,
+            mask_s=p.mask_s, mask_l=p.mask_l, align=p.align, eof=True,
+            cand_cap=cc, chunk_cap=kc)
+        return np.asarray(out)
+
+    monkeypatch.delenv("VOLSYNC_PAGEMAJOR", raising=False)
+    base = run()
+    monkeypatch.setenv("VOLSYNC_PAGEMAJOR", "1")
+    try:
+        flipped = run()
+    finally:
+        monkeypatch.delenv("VOLSYNC_PAGEMAJOR", raising=False)
+        jax.clear_caches()
+    np.testing.assert_array_equal(base, flipped)

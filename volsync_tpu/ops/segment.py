@@ -93,16 +93,43 @@ def _compact_candidates(mask: jax.Array, cand_cap: int, R: int,
                      sentinel)
 
 
+def _use_pagemajor() -> bool:
+    """Opt-in page-major digest-table layout (word j of page p at
+    p*8 + j instead of j*n_pages_pad + p): each root-loop lane then
+    gathers CONTIGUOUS 16U+1-word runs instead of 8-plane strides.
+    Off by default until the on-chip A/B (scripts/profile_root.py
+    measures both via the word_index override) proves it; the mesh
+    path always stays word-major (its cross-shard word_index assumes
+    the per-shard kernel layout)."""
+    import os
+
+    return bool(os.environ.get("VOLSYNC_PAGEMAJOR"))
+
+
+def _word_index_fn(n_pages_pad: int, pagemajor: bool):
+    """THE home of the digest-table index formula — every producer,
+    tail override, root gather, and host decode must route through
+    this one mapping or the layouts silently desynchronize."""
+    if pagemajor:
+        return lambda j, p: p * 8 + j
+    return lambda j, p: j * n_pages_pad + p
+
+
 def _apply_tail_overrides(flat: jax.Array, n_pages_pad: int,
                           tail_pages: jax.Array, tail_digs: jax.Array,
-                          has_tail: jax.Array) -> jax.Array:
-    """Overwrite the word-major page-digest table with per-lane partial
-    tail-leaf digests (lanes with has_tail False write out of bounds ->
-    dropped). tail_pages/has_tail: [N]; tail_digs: [N, 8]. Shared by
-    the single, batched, and span programs so the word-major indexing
-    (digest word j of page p at j*n_pages_pad + p) has ONE home."""
+                          has_tail: jax.Array,
+                          pagemajor: bool | None = None) -> jax.Array:
+    """Overwrite the page-digest table with per-lane partial tail-leaf
+    digests (lanes with has_tail False write out of bounds -> dropped).
+    tail_pages/has_tail: [N]; tail_digs: [N, 8]. Shared by the single,
+    batched, and span programs so the layout indexing (word-major:
+    digest word j of page p at j*n_pages_pad + p; page-major: at
+    p*8 + j) has ONE home."""
+    if pagemajor is None:
+        pagemajor = _use_pagemajor()
+    wi = _word_index_fn(n_pages_pad, pagemajor)
     j8 = jnp.arange(8, dtype=jnp.int32)[None, :]
-    ovr = jnp.where(has_tail[:, None], j8 * n_pages_pad + tail_pages[:, None],
+    ovr = jnp.where(has_tail[:, None], wi(j8, tail_pages[:, None]),
                     8 * n_pages_pad)  # OOB -> dropped
     return flat.at[ovr.reshape(-1)].set(tail_digs.reshape(-1), mode="drop")
 
@@ -229,9 +256,40 @@ def _pallas_transpose(x: jax.Array) -> jax.Array:
     )(x)
 
 
-def _page_digests_flat(data: jax.Array, n_pages_pad: int) -> jax.Array:
-    """SHA-256 of every 4 KiB page of ``data``, WORD-MAJOR flat layout:
-    result[j * n_pages_pad + p] = word j of page p's digest.
+def _relayout_kernel(x_ref, o_ref):
+    # [8, 512] (word j x page p) -> [32, 128] page-major flat rows:
+    # x.T element order is p-major, j-minor == the page-major stream.
+    o_ref[...] = x_ref[...].T.reshape(32, 128)
+
+
+def _pallas_pagemajor(out: jax.Array, n_pages_pad: int) -> jax.Array:
+    """Kernel-layout digests [8, npp/128, 128] -> [npp*8] page-major
+    via VMEM shuffles (an XLA transpose of the data-sized table runs at
+    ~1% of HBM speed on the tunnel AOT path; this is the same trick as
+    _pallas_transpose at the digest table's shape)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x = out.reshape(8, n_pages_pad)
+    y = pl.pallas_call(
+        _relayout_kernel,
+        grid=(n_pages_pad // 512,),
+        in_specs=[pl.BlockSpec((8, 512), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((32, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pages_pad * 8 // 128, 128),
+                                       jnp.uint32),
+    )(x)
+    return y.reshape(-1)
+
+
+def _page_digests_flat(data: jax.Array, n_pages_pad: int,
+                       pagemajor: bool | None = None) -> jax.Array:
+    """SHA-256 of every 4 KiB page of ``data``, flat layout: by default
+    WORD-MAJOR (result[j * n_pages_pad + p] = word j of page p's
+    digest); ``pagemajor`` (default: the VOLSYNC_PAGEMAJOR gate) packs
+    page p's 8 words contiguously at p*8 instead.
 
     data: [P] uint8, P % LEAF_SIZE == 0; hashes are computed for
     ``n_pages_pad`` >= P/LEAF_SIZE pages (the pad region hashes zeros
@@ -239,17 +297,23 @@ def _page_digests_flat(data: jax.Array, n_pages_pad: int) -> jax.Array:
 
     TPU: pack_words (elementwise) -> Pallas tile-transpose -> the
     Pallas SHA lane kernel; the digest output stays in the kernel's
-    [8, B/128, 128] layout, whose row-major flattening IS word-major.
-    CPU (tests/dry-runs): the XLA scan path + a small transpose.
+    [8, B/128, 128] layout, whose row-major flattening IS word-major
+    (page-major adds one small Pallas relayout pass over the
+    1/128-data-sized table). CPU (tests/dry-runs): the XLA scan path +
+    a small transpose.
     """
     P = data.shape[0]
     F = P // LEAF_SIZE
+    if pagemajor is None:
+        pagemajor = _use_pagemajor()
 
     if not use_pallas_leaves():
         wb = pack_words(data)  # [P/64, 16]
         rows0 = jnp.arange(n_pages_pad, dtype=jnp.int32) * (LEAF_SIZE // 64)
         rows0 = jnp.minimum(rows0, P // 64 - LEAF_SIZE // 64)
         dig = _sha256_rows(wb, rows0, LEAF_SIZE)  # [n_pages_pad, 8]
+        if pagemajor:
+            return dig.reshape(-1)
         return dig.T.reshape(-1)
 
     # Words packed straight into [F, 1024]: any [*, 16]-minor layout
@@ -283,6 +347,8 @@ def _page_digests_flat(data: jax.Array, n_pages_pad: int) -> jax.Array:
                                        jnp.uint32),
         scratch_shapes=[pltpu.VMEM((8, _LANE_SUB, 128), jnp.uint32)],
     )(x)
+    if pagemajor:
+        return _pallas_pagemajor(out, n_pages_pad)
     return out.reshape(-1)  # [8 * n_pages_pad], word-major
 
 
@@ -296,10 +362,11 @@ def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live,
     leaf digests) from word-major page digests.
 
     flat: flattened u32 page digests; by default word j of page p lives
-    at j*n_pages_pad + p (the single-chip kernel layout, tail-leaf
-    override already applied). ``word_index(j, p)`` overrides that
-    mapping — the mesh-sharded path passes the all-gathered per-shard
-    layout's index function. page0: [C_cap] first page of each chunk;
+    at j*n_pages_pad + p (word-major kernel layout), or at p*8 + j when
+    the VOLSYNC_PAGEMAJOR gate is on (tail-leaf override already
+    applied either way). ``word_index(j, p)`` overrides the mapping —
+    the mesh-sharded path passes the all-gathered per-shard layout's
+    index function. page0: [C_cap] first page of each chunk;
     nleaves/lens/live: the chunk table.
 
     The digest stream of chunk c is D(t) = flat[word_index(t%8,
@@ -329,8 +396,7 @@ def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live,
 
     Fp = n_pages_pad
     if word_index is None:
-        def word_index(j, p):
-            return j * Fp + p
+        word_index = _word_index_fn(Fp, _use_pagemajor())
     # U message blocks per while iteration: ONE [C_cap, 16U+1] gather
     # covers all U sub-blocks (each needs D words m*16-4+j, j<=16 — the
     # sub-slices overlap by one word), so the loop pays the gather and
@@ -556,20 +622,28 @@ def chunk_hash_segments(data: jax.Array, valid_len: jax.Array,
         roots.reshape(S, chunk_cap * 8)], axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("n_pages_pad",))
-def _page_digests_jit(data, n_pages_pad: int):
-    return _page_digests_flat(data, n_pages_pad)
+@functools.partial(jax.jit, static_argnames=("n_pages_pad", "pagemajor"))
+def _page_digests_jit(data, n_pages_pad: int, pagemajor: bool):
+    return _page_digests_flat(data, n_pages_pad, pagemajor=pagemajor)
 
 
 def page_digests(dev) -> np.ndarray:
     """SHA-256 of every full 4 KiB page of a resident buffer ->
     [P/4096, 8] big-endian-word ndarray (one dispatch, one fetch of
-    32 bytes per page). The streaming whole-file hasher's primitive."""
+    32 bytes per page). The streaming whole-file hasher's primitive.
+
+    The layout gate is read ONCE here and passed as a static jit arg —
+    the trace and the host-side decode can never disagree (a cached
+    pre-flip trace reinterpreted in the other layout would produce
+    garbage digests silently)."""
     P = int(dev.shape[0])
     F = P // LEAF_SIZE
     npps = _n_pages_pad(F)
-    flat = np.asarray(_page_digests_jit(dev, npps))
-    return flat.reshape(8, npps).T[:F]
+    pm = _use_pagemajor()
+    flat = np.asarray(_page_digests_jit(dev, npps, pm))
+    wi = _word_index_fn(npps, pm)
+    j, p = np.meshgrid(np.arange(8), np.arange(F), indexing="xy")
+    return flat[wi(j, p)]  # [F, 8]: j/p broadcast to (F, 8)
 
 
 @jax.jit

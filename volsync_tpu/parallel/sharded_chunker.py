@@ -328,7 +328,11 @@ def _build_fused_fn(mesh, params: GearParams, shard_len: int,
         valid_len = valid_len.astype(jnp.int32)
 
         # --- per-shard page digests (no halo: pages don't cross seams)
-        flat_local = _page_digests_flat(row, npps)  # [8 * npps]
+        # Always word-major here: the cross-shard word_index below
+        # assumes the per-shard kernel layout regardless of the
+        # single-chip VOLSYNC_PAGEMAJOR gate.
+        flat_local = _page_digests_flat(row, npps,
+                                        pagemajor=False)  # [8 * npps]
         flat_g = jax.lax.all_gather(flat_local, SEQ, axis=0)  # [S, 8*npps]
         flat_g = flat_g.reshape(S * 8 * npps)
 
