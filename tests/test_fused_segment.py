@@ -225,3 +225,67 @@ def test_pagemajor_layout_bit_identical(rng, monkeypatch):
         monkeypatch.delenv("VOLSYNC_PAGEMAJOR", raising=False)
         jax.clear_caches()
     np.testing.assert_array_equal(base, flipped)
+
+
+def test_walk_table_randomized_vs_scalar_reference(rng):
+    """Property test for the successor-table walk: random candidate
+    sets and lengths (including L < min_size, L a page multiple, L-1
+    cuts, empty candidate sets, chunk_cap truncation) must match the
+    scalar reference walk exactly."""
+    import jax.numpy as jnp
+
+    from volsync_tpu.ops import segment as seg
+    from volsync_tpu.ops.gearcdc import GearParams, _select_boundaries_py
+
+    p = GearParams(min_size=4096, avg_size=32768, max_size=65536,
+                   seed=1, align=4096)
+    align = p.align
+    sent = 2**31 - 2
+    for trial in range(40):
+        n_rows = int(rng.randint(1, 64))
+        P = n_rows * align
+        # random candidate rows; strict subset of lax (as in the real
+        # mask relationship)
+        density = rng.choice([0.0, 0.05, 0.3, 0.8])
+        lax_rows = np.nonzero(rng.rand(n_rows) < density)[0]
+        strict_rows = lax_rows[rng.rand(lax_rows.shape[0]) < 0.4]
+        pos_l_np = lax_rows * align + (align - 1)
+        pos_s_np = strict_rows * align + (align - 1)
+        if trial % 3 == 0:
+            L = P  # exact page multiple
+        elif trial % 3 == 1:
+            L = int(rng.randint(1, P + 1))  # arbitrary
+        else:
+            L = max(1, P - int(rng.randint(0, align)))  # near the end
+        eof = bool(rng.randint(0, 2))
+        chunk_cap = int(rng.choice([2, 4, 256]))  # incl. truncation
+        cap = 128
+        idx_s = pos_s_np[pos_s_np < L]
+        idx_l = pos_l_np[pos_l_np < L]
+
+        def padded(a):
+            out = np.full((cap,), sent, np.int32)
+            out[: a.shape[0]] = a
+            return jnp.asarray(out)
+
+        starts, lens, count, consumed = seg._select_boundaries_device(
+            padded(idx_s), jnp.int32(idx_s.shape[0]),
+            padded(idx_l), jnp.int32(idx_l.shape[0]),
+            jnp.int32(L), min_size=p.min_size, avg_size=p.avg_size,
+            max_size=p.max_size, chunk_cap=chunk_cap, eof=eof,
+            align=align, n_rows=n_rows)
+        count = int(count)
+        got = [(int(starts[c]), int(lens[c])) for c in range(count)]
+        ref = _select_boundaries_py(idx_s, idx_l, L, p, eof=eof)
+        assert got == ref[:chunk_cap], \
+            (trial, n_rows, L, eof, chunk_cap, got, ref)
+        ref_pos = (ref[-1][0] + ref[-1][1]) if ref else 0
+        if count < chunk_cap:
+            # full walk: consumed == the reference's final position
+            # (== L for eof, since the final chunk ends at L-1)
+            assert int(consumed) == ref_pos
+        else:
+            # truncated walk: consumed must be exactly the end of the
+            # last emitted chunk — the capacity-retry protocol
+            # (decode_with_overflow_check) keys on it
+            assert int(consumed) == got[-1][0] + got[-1][1]
