@@ -122,8 +122,7 @@ def test_prune_sweeps_crash_orphans(tmp_path, src_tree):
     # present must be one the retry legitimately re-referenced in the
     # index (content-addressed reuse); unreferenced orphans are gone.
     with repo3._lock:
-        entries = repo3._index.copy()
-    referenced = {f"data/{pack[:2]}/{pack}"
-                  for pack, *_ in entries.values() if pack}
+        referenced = {f"data/{p[:2]}/{p}"
+                      for p in repo3._index.live_packs() if p}
     leftover_orphans = (orphan_packs & after) - referenced
     assert not leftover_orphans, leftover_orphans
