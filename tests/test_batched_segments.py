@@ -224,3 +224,20 @@ def test_microbatcher_pipelined_concurrent_submits(rng):
     for b, (chunks, consumed), w in zip(items, got, want):
         assert chunks == w
         assert consumed == len(b)
+
+
+def test_batching_default_follows_backend(monkeypatch):
+    """Unset VOLSYNC_BATCH_SEGMENTS -> batching defaults ON only for
+    real TPU backends; explicit 0/1 always wins."""
+    import jax
+
+    from volsync_tpu.ops import batcher as bm
+
+    monkeypatch.delenv("VOLSYNC_BATCH_SEGMENTS", raising=False)
+    assert bm._batching_enabled() is (jax.default_backend() == "tpu")
+    monkeypatch.setenv("VOLSYNC_BATCH_SEGMENTS", "1")
+    assert bm._batching_enabled() is True
+    monkeypatch.setenv("VOLSYNC_BATCH_SEGMENTS", "0")
+    assert bm._batching_enabled() is False
+    monkeypatch.setenv("VOLSYNC_BATCH_SEGMENTS", "false")
+    assert bm._batching_enabled() is False

@@ -116,11 +116,13 @@ class DeviceChunkHasher:
         runs synchronously here and only the leaf digests stay in
         flight.
 
-        With VOLSYNC_BATCH_SEGMENTS=1 (ops/batcher.shared_batcher) the
-        fused path routes through the process-wide microbatcher:
-        concurrent workers' segments — different files of one
-        TreeBackup, different CRs' movers in one operator — coalesce
-        into single cross-PVC batched dispatches."""
+        When batching is enabled (ops/batcher._batching_enabled:
+        VOLSYNC_BATCH_SEGMENTS=1, or unset on a TPU backend — the
+        backend-aware default) the fused path routes through the
+        process-wide microbatcher: concurrent workers' segments —
+        different files of one TreeBackup, different CRs' movers in one
+        operator — coalesce into single cross-PVC batched
+        dispatches."""
         import jax.numpy as jnp
 
         if isinstance(buffer, (bytes, bytearray, memoryview)):

@@ -145,13 +145,28 @@ _SHARED: dict = {}
 _SHARED_LOCK = threading.Lock()
 
 
+def _batching_enabled() -> bool:
+    """VOLSYNC_BATCH_SEGMENTS: "1" forces on, "0"/"false"/"no" forces
+    off. Unset -> backend-aware default: ON on real TPU backends (the
+    measured ~7 ms/dispatch execution overhead and ~80 ms result round
+    trip make coalescing a clear win there), OFF on the CPU backend
+    (compute-bound; batching measurably loses)."""
+    raw = os.environ.get("VOLSYNC_BATCH_SEGMENTS")
+    if raw is not None:
+        return raw.strip().lower() not in ("", "0", "false", "no")
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 def shared_batcher(params: GearParams):
     """Process-wide microbatcher per chunker-params (the local engine's
-    opt-in batching path, VOLSYNC_BATCH_SEGMENTS=1): TreeBackup workers
-    hashing different files — and different CRs' movers in one operator
-    process — coalesce through one instance. Returns None when batching
-    is disabled or the params aren't page-aligned."""
-    if not os.environ.get("VOLSYNC_BATCH_SEGMENTS"):
+    batching path): TreeBackup workers hashing different files — and
+    different CRs' movers in one operator process — coalesce through
+    one instance. Returns None when batching is disabled (see
+    _batching_enabled: default follows the backend) or the params
+    aren't page-aligned."""
+    if not _batching_enabled():
         return None
     if params.align != 4096:
         return None
