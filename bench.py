@@ -701,9 +701,12 @@ def main():
                                  CPU_MEASURE_TIMEOUT_S)
     if out is not None:
         out["backend"] = "cpu-fallback"
-        out["note"] = ("TPU backend unreachable at bench time; this is "
-                       "the labeled CPU-backend fallback, not an "
-                       "accelerator number (see docs/round4.md)")
+        out["note"] = ("TPU backend unreachable at bench time (see "
+                       "docs/performance.md: single-tenant tunnel "
+                       "session leak); this is the labeled CPU-backend "
+                       "fallback, not an accelerator number. The last "
+                       "builder-run LIVE-chip measurement with full "
+                       "provenance is BENCH_SELF_r04.json")
         _emit(out)
         return 0
     _log("bench: every measurement path failed")

@@ -25,8 +25,9 @@ jax.config.update("jax_platforms", "cpu")
 # Pin segment batching OFF for the suite (the default is backend-aware
 # — ON for TPU): tests that exercise batching opt in explicitly with
 # monkeypatch.setenv, and every "unbatched reference" run stays
-# genuinely unbatched even if this suite ever runs against a real chip.
-os.environ.setdefault("VOLSYNC_BATCH_SEGMENTS", "0")
+# genuinely unbatched even if this suite ever runs against a real chip
+# or under an ambient VOLSYNC_BATCH_SEGMENTS=1.
+os.environ["VOLSYNC_BATCH_SEGMENTS"] = "0"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -153,7 +153,7 @@ def _batching_enabled() -> bool:
     (compute-bound; batching measurably loses)."""
     raw = os.environ.get("VOLSYNC_BATCH_SEGMENTS")
     if raw is not None:
-        return raw.strip().lower() not in ("", "0", "false", "no")
+        return raw.strip().lower() not in ("", "0", "false", "no", "off")
     import jax
 
     return jax.default_backend() == "tpu"
