@@ -1,0 +1,146 @@
+"""File-fidelity parity with the reference's rsync -H -S flags
+(mover-rsync/source.sh:54): hardlink preservation and sparse
+materialization through the backup->restore engine."""
+
+import os
+
+import numpy as np
+import pytest
+
+from volsync_tpu.engine import TreeBackup, restore_snapshot
+from volsync_tpu.objstore import MemObjectStore
+from volsync_tpu.repo.repository import Repository
+
+CHUNKER = {"min_size": 4096, "avg_size": 32768, "max_size": 65536,
+           "seed": 11, "align": 4096}
+
+
+def _mkrepo():
+    return Repository.init(MemObjectStore(), chunker=CHUNKER)
+
+
+def test_hardlinks_roundtrip(tmp_path, rng):
+    src = tmp_path / "src"
+    src.mkdir()
+    payload = rng.bytes(150_000)
+    (src / "a.bin").write_bytes(payload)
+    os.link(src / "a.bin", src / "b_link.bin")
+    (src / "sub").mkdir()
+    os.link(src / "a.bin", src / "sub" / "c_link.bin")
+    (src / "solo.bin").write_bytes(rng.bytes(60_000))
+
+    repo = _mkrepo()
+    snap, stats = TreeBackup(repo, workers=2).run(src)
+    # linked copies are not re-hashed (one content walk for the inode)
+    assert stats.bytes_scanned == 150_000 + 60_000
+
+    dst = tmp_path / "dst"
+    restore_snapshot(repo, dst)
+    assert (dst / "a.bin").read_bytes() == payload
+    assert (dst / "b_link.bin").read_bytes() == payload
+    assert (dst / "sub" / "c_link.bin").read_bytes() == payload
+    ino = (dst / "a.bin").stat().st_ino
+    assert (dst / "b_link.bin").stat().st_ino == ino
+    assert (dst / "sub" / "c_link.bin").stat().st_ino == ino
+    assert (dst / "a.bin").stat().st_nlink == 3
+    assert (dst / "solo.bin").stat().st_ino != ino
+
+    # idempotent second restore: everything skips, links stay intact
+    stats2 = restore_snapshot(repo, dst)
+    assert stats2["files"] == 0
+    assert (dst / "b_link.bin").stat().st_ino == ino
+
+
+def test_hardlink_first_path_removed_between_backups(tmp_path, rng):
+    """The secondary's parent entry must NOT feed unchanged-file dedup:
+    removing the first-seen name drops nlink 2->1 WITHOUT touching the
+    survivor's mtime, and a naive parent match would restore it empty."""
+    src = tmp_path / "src"
+    src.mkdir()
+    payload = rng.bytes(120_000)
+    (src / "a.bin").write_bytes(payload)
+    os.link(src / "a.bin", src / "b.bin")
+
+    repo = _mkrepo()
+    TreeBackup(repo, workers=1).run(src)
+
+    os.unlink(src / "a.bin")  # b.bin survives, mtime untouched
+    snap2, _ = TreeBackup(repo, workers=1).run(src)
+
+    dst = tmp_path / "dst"
+    restore_snapshot(repo, dst)
+    assert not (dst / "a.bin").exists()
+    assert (dst / "b.bin").read_bytes() == payload
+
+
+def test_sparse_restore_materializes_holes(tmp_path, rng):
+    src = tmp_path / "src"
+    src.mkdir()
+    head = rng.bytes(1 << 20)
+    tail = rng.bytes(1 << 20)
+    hole = 24 << 20
+    # write the source sparsely too (so the test also covers reading one)
+    with open(src / "vm.img", "wb") as f:
+        f.write(head)
+        f.seek(hole, os.SEEK_CUR)
+        f.write(tail)
+
+    repo = _mkrepo()
+    TreeBackup(repo, workers=1).run(src)
+    dst = tmp_path / "dst"
+    restore_snapshot(repo, dst)
+
+    out = dst / "vm.img"
+    size = (1 << 20) * 2 + hole
+    assert out.stat().st_size == size
+    with open(out, "rb") as f:
+        assert f.read(1 << 20) == head
+        f.seek(hole, os.SEEK_CUR)
+        assert f.read() == tail
+    # the hole is a hole: allocation far below the logical size
+    allocated = out.stat().st_blocks * 512
+    assert allocated < size // 2, (allocated, size)
+
+
+def test_sparse_disabled_writes_dense(tmp_path, rng, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    data = bytes(8 << 20)  # all zeros
+    (src / "z.bin").write_bytes(data)
+    repo = _mkrepo()
+    TreeBackup(repo, workers=1).run(src)
+
+    monkeypatch.setenv("VOLSYNC_SPARSE", "0")
+    dst = tmp_path / "dense"
+    restore_snapshot(repo, dst)
+    out = dst / "z.bin"
+    assert out.read_bytes() == data
+    assert out.stat().st_blocks * 512 >= len(data)
+
+
+def test_diverged_hardlink_restore_over_linked_dest(tmp_path, rng):
+    """Restoring a snapshot where a formerly-linked pair diverged, over
+    a destination that still HAS them linked, must break the link
+    instead of writing both paths through the shared inode (which would
+    corrupt under the worker pool)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    payload = rng.bytes(100_000)
+    (src / "a.bin").write_bytes(payload)
+    os.link(src / "a.bin", src / "b.bin")
+    repo = _mkrepo()
+    TreeBackup(repo, workers=1).run(src)
+    dst = tmp_path / "dst"
+    restore_snapshot(repo, dst)
+    assert (dst / "a.bin").stat().st_ino == (dst / "b.bin").stat().st_ino
+
+    # diverge: b becomes independent content
+    os.unlink(src / "b.bin")
+    other = rng.bytes(90_000)
+    (src / "b.bin").write_bytes(other)
+    TreeBackup(repo, workers=4).run(src)
+
+    restore_snapshot(repo, dst)
+    assert (dst / "a.bin").read_bytes() == payload
+    assert (dst / "b.bin").read_bytes() == other
+    assert (dst / "a.bin").stat().st_ino != (dst / "b.bin").stat().st_ino

@@ -42,7 +42,12 @@ def _load_parent_files(repo: Repository, parent_tree: str,
     for entry in tree["entries"]:
         path = f"{prefix}{entry['name']}"
         if entry["type"] == "file":
-            out[path] = entry
+            # Hardlink-secondary entries carry no content of their own;
+            # offering them for unchanged-file dedup would match a
+            # now-unlinked file (nlink 2->1 leaves mtime untouched) and
+            # resolve it to empty content.
+            if "hardlink_to" not in entry:
+                out[path] = entry
         elif entry["type"] == "dir":
             out.update(_load_parent_files(repo, entry["subtree"], path + "/"))
     return out
@@ -123,7 +128,9 @@ class TreeBackup:
         # Single-threaded walk (stats + unchanged-file dedup decisions),
         # concurrent per-file hashing, deterministic tree assembly.
         jobs: list[tuple[Path, str, object]] = []
-        skeleton = self._walk_dir(root, "", parent_files, stats, jobs)
+        inode_first: dict = {}  # (st_dev, st_ino) -> rel of first sight
+        skeleton = self._walk_dir(root, "", parent_files, stats, jobs,
+                                  inode_first)
         contents: dict = {}
         if jobs:
             if self.workers > 1 and len(jobs) > 1:
@@ -157,7 +164,8 @@ class TreeBackup:
     # -- internals ----------------------------------------------------------
 
     def _walk_dir(self, dirpath: Path, rel: str, parent_files: dict,
-                  stats: BackupStats, jobs: list) -> dict:
+                  stats: BackupStats, jobs: list,
+                  inode_first: dict) -> dict:
         """Single-threaded walk -> a skeleton tree. File entries that
         need hashing carry content=None and append a job; unchanged
         files resolve to the parent's content list immediately. All
@@ -174,11 +182,27 @@ class TreeBackup:
                                 "target": os.readlink(child)})
             elif stat_mod.S_ISDIR(st.st_mode):
                 sub = self._walk_dir(child, f"{rel}{child.name}/",
-                                     parent_files, stats, jobs)
+                                     parent_files, stats, jobs,
+                                     inode_first)
                 entries.append({**meta, "type": "dir", "skeleton": sub})
             elif stat_mod.S_ISREG(st.st_mode):
                 frel = f"{rel}{child.name}"
                 stats.files += 1
+                # Hardlink preservation (reference: rsync -H in
+                # mover-rsync/source.sh:54): later sightings of a
+                # multiply-linked inode record a link to the FIRST
+                # sighting's path (deterministic — the walk is sorted
+                # and single-threaded) instead of re-hashing content.
+                if st.st_nlink > 1:
+                    ino = (st.st_dev, st.st_ino)
+                    first = inode_first.get(ino)
+                    if first is not None:
+                        entries.append({**meta, "type": "file",
+                                        "size": st.st_size,
+                                        "hardlink_to": first,
+                                        "content": [], "rel": frel})
+                        continue
+                    inode_first[ino] = frel
                 stats.bytes_scanned += st.st_size
                 prev = parent_files.get(frel)
                 if (prev is not None and prev["size"] == st.st_size

@@ -35,9 +35,14 @@ class TreeRestore:
         # ~64 MiB batches, host keeps only decrypt/decompress. Batches
         # verify BEFORE their bytes are written, so corruption is
         # caught exactly as early as the host path would.
-        self.device_verify = os.environ.get(
-            "VOLSYNC_DEVICE_VERIFY", "").lower() not in (
-            "", "0", "false", "no")
+        from volsync_tpu.envflags import env_bool
+
+        self.device_verify = env_bool("VOLSYNC_DEVICE_VERIFY")
+        # Sparse materialization (the rsync -S analogue,
+        # mover-rsync/source.sh:54): aligned all-zero pages become
+        # holes. Content-identical; VOLSYNC_SPARSE=0 restores dense
+        # writes.
+        self.sparse = env_bool("VOLSYNC_SPARSE", default=True)
 
     def run(self, snap_id: str, manifest: dict, dest,
             *, delete_extra: bool = True) -> dict:
@@ -56,7 +61,8 @@ class TreeRestore:
         stats = {"files": 0, "bytes": 0, "skipped": 0, "deleted": 0}
         jobs: list[tuple[dict, Path]] = []
         dirs: list[tuple[Path, dict]] = []
-        self._walk_tree(manifest["tree"], dest, stats, jobs, dirs,
+        links: list[tuple[dict, Path]] = []
+        self._walk_tree(manifest["tree"], dest, stats, jobs, dirs, links,
                         delete_extra=delete_extra)
         if jobs:
             if self.workers > 1 and len(jobs) > 1:
@@ -70,6 +76,20 @@ class TreeRestore:
             for key, nbytes in results:
                 stats[key] += 1
                 stats["bytes"] += nbytes
+        # Hardlinks AFTER the file pool: the link's source path is only
+        # guaranteed to exist (with final content) once every file job
+        # has run. Metadata is shared with the source inode, already
+        # applied there.
+        for entry, target in links:
+            source = dest / entry["hardlink_to"]
+            if target.exists() and not target.is_symlink() \
+                    and os.path.samestat(target.lstat(), source.lstat()):
+                stats["skipped"] += 1
+                continue
+            if target.is_symlink() or target.exists():
+                _rmtree(target)
+            os.link(source, target)
+            stats["files"] += 1
         # Directory metadata last, children-first: any earlier write
         # inside a directory would overwrite its restored mtime.
         for path, entry in reversed(dirs):
@@ -78,7 +98,8 @@ class TreeRestore:
         return stats
 
     def _walk_tree(self, tree_id: str, dirpath: Path, stats: dict,
-                   jobs: list, dirs: list, *, delete_extra: bool):
+                   jobs: list, dirs: list, links: list, *,
+                   delete_extra: bool):
         tree = json.loads(self.repo.read_blob(tree_id))
         wanted = {e["name"] for e in tree["entries"]}
         if delete_extra:
@@ -94,7 +115,7 @@ class TreeRestore:
                 target.mkdir(exist_ok=True)
                 dirs.append((target, entry))
                 self._walk_tree(entry["subtree"], target, stats, jobs,
-                                dirs, delete_extra=delete_extra)
+                                dirs, links, delete_extra=delete_extra)
             elif entry["type"] == "symlink":
                 if target.is_symlink() or target.exists():
                     _rmtree(target)
@@ -102,7 +123,10 @@ class TreeRestore:
                 os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]),
                          follow_symlinks=False)
             elif entry["type"] == "file":
-                jobs.append((entry, target))
+                if entry.get("hardlink_to"):
+                    links.append((entry, target))
+                else:
+                    jobs.append((entry, target))
 
     def _restore_file(self, entry: dict, target: Path) -> tuple[str, int]:
         if (target.is_file() and not target.is_symlink()
@@ -115,22 +139,34 @@ class TreeRestore:
             return "skipped", 0
         if target.is_symlink() or target.is_dir():
             _rmtree(target)
+        elif target.exists() and target.lstat().st_nlink > 1:
+            # Break a pre-existing hardlink before writing: an in-place
+            # open("wb") would write through the SHARED inode and
+            # corrupt the other linked path (and race against its own
+            # restore job under the worker pool).
+            target.unlink()
+        write = _write_sparse if self.sparse else (
+            lambda f_, d: f_.write(d))
         with open(target, "wb") as f:
             if self.device_verify:
-                self._write_device_verified(f, entry["content"])
+                self._write_device_verified(f, entry["content"], write)
             else:
                 for blob_id in entry["content"]:
-                    f.write(self.repo.read_blob(blob_id))
+                    write(f, self.repo.read_blob(blob_id))
+            if self.sparse:
+                # materialize a trailing hole (seek alone doesn't extend)
+                f.truncate(f.tell())
         os.chmod(target, entry["mode"])
         os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
         return "files", entry["size"]
 
     _VERIFY_BATCH = 64 * 1024 * 1024
 
-    def _write_device_verified(self, f, content: list):
+    def _write_device_verified(self, f, content: list, write):
         """Raw blob reads in ~64 MiB groups, ONE device dispatch
         re-derives the group's blob ids, bytes hit the file only after
-        their group verifies (engine/chunker.verify_blob_batch)."""
+        their group verifies (engine/chunker.verify_blob_batch);
+        ``write(f, data)`` is the caller's (possibly sparse) writer."""
         from volsync_tpu.engine.chunker import verify_blob_batch
         from volsync_tpu.repo import crypto
 
@@ -144,7 +180,7 @@ class TreeRestore:
                 raise crypto.IntegrityError(
                     f"restore: blob {bad[0]} content hash mismatch")
             for _, data in group:
-                f.write(data)
+                write(f, data)
             group, gbytes = [], 0
 
         for blob_id in content:
@@ -154,6 +190,36 @@ class TreeRestore:
             if gbytes >= self._VERIFY_BATCH:
                 flush()
         flush()
+
+
+_ZERO_PAGE = bytes(4096)
+
+
+def _write_sparse(f, data) -> None:
+    """rsync -S analogue: aligned runs of all-zero 4 KiB pages become
+    seeks (holes) instead of writes — content identical, allocation
+    not. Dense data short-circuits to one bulk write (the zero-page
+    substring scan is C-speed memchr territory)."""
+    if _ZERO_PAGE not in data:
+        f.write(data)
+        return
+    if not data.strip(b"\0"):  # wholly zero
+        f.seek(len(data), os.SEEK_CUR)
+        return
+    view = memoryview(data)
+    n = len(data)
+    i = 0
+    while i < n:
+        j = min(i + 4096, n)
+        if j - i == 4096 and view[i:j] == _ZERO_PAGE:
+            k = j
+            while k + 4096 <= n and view[k:k + 4096] == _ZERO_PAGE:
+                k += 4096
+            f.seek(k - i, os.SEEK_CUR)
+            i = k
+        else:
+            f.write(view[i:j])
+            i = j
 
 
 def _rmtree(path: Path):

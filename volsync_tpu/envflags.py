@@ -1,0 +1,21 @@
+"""One home for boolean env-knob parsing.
+
+Every operational toggle (VOLSYNC_DEVICE_VERIFY, VOLSYNC_SPARSE,
+VOLSYNC_BATCH_SEGMENTS, ...) parses through here so the falsy-token
+set cannot drift between copies — "off" disabling one knob but
+enabling another is exactly the bug class this prevents.
+"""
+
+from __future__ import annotations
+
+import os
+
+_FALSY = ("", "0", "false", "no", "off")
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """True/False from the environment; unset -> ``default``."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSY

@@ -151,9 +151,10 @@ def _batching_enabled() -> bool:
     measured ~7 ms/dispatch execution overhead and ~80 ms result round
     trip make coalescing a clear win there), OFF on the CPU backend
     (compute-bound; batching measurably loses)."""
-    raw = os.environ.get("VOLSYNC_BATCH_SEGMENTS")
-    if raw is not None:
-        return raw.strip().lower() not in ("", "0", "false", "no", "off")
+    from volsync_tpu.envflags import env_bool
+
+    if os.environ.get("VOLSYNC_BATCH_SEGMENTS") is not None:
+        return env_bool("VOLSYNC_BATCH_SEGMENTS")
     import jax
 
     return jax.default_backend() == "tpu"

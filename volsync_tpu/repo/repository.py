@@ -836,11 +836,9 @@ class Repository:
             if read_data:
                 to_read.append(blob_id)
         if device_verify is None:
-            import os as _os
+            from volsync_tpu.envflags import env_bool
 
-            device_verify = _os.environ.get(
-                "VOLSYNC_DEVICE_VERIFY", "").lower() not in (
-                "", "0", "false", "no")
+            device_verify = env_bool("VOLSYNC_DEVICE_VERIFY")
         if to_read and device_verify:
             problems.extend(self._verify_blobs_device(to_read, workers))
         elif to_read:
