@@ -34,6 +34,27 @@ def _tree_id(tree_json: bytes) -> str:
     return blobid.blob_id(tree_json)
 
 
+def _read_xattrs(path) -> dict:
+    """Extended attributes (incl. POSIX ACLs, which live in
+    system.posix_acl_*) as {name: base64}; the reference's rsync -A /
+    rclone getfacl round-trip analogue. Filesystems without xattr
+    support contribute nothing."""
+    import base64
+
+    try:
+        names = os.listxattr(path, follow_symlinks=False)
+    except OSError:
+        return {}
+    out = {}
+    for n in sorted(names):
+        try:
+            out[n] = base64.b64encode(
+                os.getxattr(path, n, follow_symlinks=False)).decode()
+        except OSError:
+            continue
+    return out
+
+
 def _load_parent_files(repo: Repository, parent_tree: str,
                        prefix: str = "") -> dict:
     """Flatten the parent snapshot's tree into {relpath: file entry}."""
@@ -177,6 +198,12 @@ class TreeBackup:
             st = child.lstat()
             meta = {"name": child.name, "mode": st.st_mode & 0o7777,
                     "mtime_ns": st.st_mtime_ns}
+            xs = _read_xattrs(child)
+            if xs:
+                # only-when-present: tree ids of xattr-less trees stay
+                # identical to pre-xattr snapshots (parent dedup keeps
+                # working across the format addition)
+                meta["xattrs"] = xs
             if stat_mod.S_ISLNK(st.st_mode):
                 entries.append({**meta, "type": "symlink",
                                 "target": os.readlink(child)})

@@ -93,7 +93,8 @@ class TreeRestore:
         # Directory metadata last, children-first: any earlier write
         # inside a directory would overwrite its restored mtime.
         for path, entry in reversed(dirs):
-            os.chmod(path, entry["mode"])
+            _apply_xattrs(path, entry)  # before chmod: a read-only
+            os.chmod(path, entry["mode"])  # mode would block setxattr
             os.utime(path, ns=(entry["mtime_ns"], entry["mtime_ns"]))
         return stats
 
@@ -120,6 +121,7 @@ class TreeRestore:
                 if target.is_symlink() or target.exists():
                     _rmtree(target)
                 os.symlink(entry["target"], target)
+                _apply_xattrs(target, entry)
                 os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]),
                          follow_symlinks=False)
             elif entry["type"] == "file":
@@ -133,8 +135,11 @@ class TreeRestore:
                 and target.stat().st_size == entry["size"]
                 and target.stat().st_mtime_ns == entry["mtime_ns"]):
             # Content is trusted unchanged (size+mtime_ns, the same
-            # heuristic backup uses), but mode can drift without touching
-            # mtime (chmod updates only ctime) — re-apply it.
+            # heuristic backup uses), but mode and xattrs can drift
+            # without touching mtime (they update only ctime) —
+            # re-apply both, xattrs first (a read-only final mode
+            # would block setxattr for unprivileged restores).
+            _apply_xattrs(target, entry)
             os.chmod(target, entry["mode"])
             return "skipped", 0
         if target.is_symlink() or target.is_dir():
@@ -156,6 +161,7 @@ class TreeRestore:
             if self.sparse:
                 # materialize a trailing hole (seek alone doesn't extend)
                 f.truncate(f.tell())
+        _apply_xattrs(target, entry)  # before chmod (read-only modes)
         os.chmod(target, entry["mode"])
         os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
         return "files", entry["size"]
@@ -190,6 +196,41 @@ class TreeRestore:
             if gbytes >= self._VERIFY_BATCH:
                 flush()
         flush()
+
+
+def _apply_xattrs(path, entry: dict) -> None:
+    """Restore recorded extended attributes (rsync -A analogue);
+    follow_symlinks=False throughout. Namespaces the filesystem rejects
+    (e.g. user.* on symlinks) are skipped — fidelity degrades to what
+    the destination supports, as the reference movers' setfacl
+    --restore does.
+
+    Drifted extras are removed ONLY when the entry actually recorded
+    xattrs: backup encodes the key only-when-present, so an absent key
+    is indistinguishable from a pre-xattr-format snapshot — stripping
+    on absence would destroy every destination xattr when restoring an
+    older snapshot."""
+    import base64
+
+    if "xattrs" not in entry:
+        return
+    want = entry["xattrs"]
+    try:
+        have = os.listxattr(path, follow_symlinks=False)
+    except OSError:
+        return
+    for n in have:
+        if n not in want:
+            try:
+                os.removexattr(path, n, follow_symlinks=False)
+            except OSError:
+                pass
+    for n, v in want.items():
+        try:
+            os.setxattr(path, n, base64.b64decode(v),
+                        follow_symlinks=False)
+        except OSError:
+            pass
 
 
 _ZERO_PAGE = bytes(4096)
