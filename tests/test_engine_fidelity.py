@@ -178,3 +178,67 @@ def test_xattrs_roundtrip(tmp_path, rng):
     assert stats["files"] == 0  # content skipped
     assert os.getxattr(out, "user.color") == b"blue"
     assert "user.stray" not in os.listxattr(out)
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="chown needs root")
+def test_owner_and_specials_roundtrip(tmp_path, rng):
+    """uid/gid (rsync -o -g) and FIFO/socket specials (rsync -D)
+    round-trip; device nodes degrade gracefully without CAP_MKNOD."""
+    import socket
+    import stat as stat_mod
+
+    src = tmp_path / "src"
+    src.mkdir()
+    f = src / "owned.bin"
+    f.write_bytes(rng.bytes(30_000))
+    os.chown(f, 1234, 5678)
+    os.mkfifo(src / "pipe", 0o640)
+    s = socket.socket(socket.AF_UNIX)
+    s.bind(str(src / "sock"))
+    s.close()
+
+    repo = _mkrepo()
+    TreeBackup(repo, workers=1).run(src)
+    dst = tmp_path / "dst"
+    restore_snapshot(repo, dst)
+
+    st = (dst / "owned.bin").stat()
+    assert (st.st_uid, st.st_gid) == (1234, 5678)
+    pst = (dst / "pipe").lstat()
+    assert stat_mod.S_ISFIFO(pst.st_mode)
+    assert pst.st_mode & 0o7777 == 0o640
+    assert stat_mod.S_ISSOCK((dst / "sock").lstat().st_mode)
+
+    # idempotent: second restore skips the specials, keeps them intact
+    stats2 = restore_snapshot(repo, dst)
+    assert stats2["files"] == 0
+    assert stat_mod.S_ISFIFO((dst / "pipe").lstat().st_mode)
+
+    # owner drift on an unchanged file converges (ctime-only change)
+    os.chown(dst / "owned.bin", 0, 0)
+    restore_snapshot(repo, dst)
+    st = (dst / "owned.bin").stat()
+    assert (st.st_uid, st.st_gid) == (1234, 5678)
+
+
+def test_special_replaced_by_file_between_snapshots(tmp_path, rng):
+    """Snapshot A has a FIFO at x; snapshot B a regular file. Restoring
+    B over A's output must replace the node — opening the FIFO in place
+    would block forever on a reader-less pipe."""
+    import stat as stat_mod
+
+    src = tmp_path / "src"
+    src.mkdir()
+    os.mkfifo(src / "x")
+    repo = _mkrepo()
+    TreeBackup(repo, workers=1).run(src)
+    dst = tmp_path / "dst"
+    restore_snapshot(repo, dst)
+    assert stat_mod.S_ISFIFO((dst / "x").lstat().st_mode)
+
+    os.unlink(src / "x")
+    payload = rng.bytes(20_000)
+    (src / "x").write_bytes(payload)
+    TreeBackup(repo, workers=1).run(src)
+    restore_snapshot(repo, dst)
+    assert (dst / "x").read_bytes() == payload

@@ -204,6 +204,14 @@ class TreeBackup:
                 # identical to pre-xattr snapshots (parent dedup keeps
                 # working across the format addition)
                 meta["xattrs"] = xs
+            # owner/group (rsync -o -g, part of the reference's -a;
+            # mover-rsync/source.sh:54). Recorded unconditionally:
+            # root:root must be restorable too (ownership drift on a
+            # root-owned file has to converge back), and restore treats
+            # an ABSENT key — a pre-format snapshot — as "unknown,
+            # leave the destination's owner alone".
+            meta["uid"] = st.st_uid
+            meta["gid"] = st.st_gid
             if stat_mod.S_ISLNK(st.st_mode):
                 entries.append({**meta, "type": "symlink",
                                 "target": os.readlink(child)})
@@ -246,7 +254,19 @@ class TreeBackup:
                     jobs.append((child, frel, st))
                 entries.append({**meta, "type": "file", "size": st.st_size,
                                 "content": content, "rel": frel})
-            # sockets/devices are skipped, as the data movers do
+            elif stat_mod.S_ISFIFO(st.st_mode) or stat_mod.S_ISSOCK(
+                    st.st_mode) or stat_mod.S_ISBLK(st.st_mode) \
+                    or stat_mod.S_ISCHR(st.st_mode):
+                # specials (rsync -D, part of the reference's -a): FIFOs
+                # and sockets recreate from the mode; device nodes also
+                # carry st_rdev. Restore degrades gracefully without
+                # CAP_MKNOD (devices need it; FIFOs/sockets don't).
+                special = {**meta, "type": "special",
+                           "fmt": stat_mod.S_IFMT(st.st_mode)}
+                if stat_mod.S_ISBLK(st.st_mode) or stat_mod.S_ISCHR(
+                        st.st_mode):
+                    special["rdev"] = st.st_rdev
+                entries.append(special)
         return {"entries": entries}
 
     def _assemble_tree(self, skeleton: dict, contents: dict,

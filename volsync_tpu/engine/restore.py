@@ -94,7 +94,8 @@ class TreeRestore:
         # inside a directory would overwrite its restored mtime.
         for path, entry in reversed(dirs):
             _apply_xattrs(path, entry)  # before chmod: a read-only
-            os.chmod(path, entry["mode"])  # mode would block setxattr
+            _apply_owner(path, entry)   # mode would block setxattr;
+            os.chmod(path, entry["mode"])  # chown clears suid -> last
             os.utime(path, ns=(entry["mtime_ns"], entry["mtime_ns"]))
         return stats
 
@@ -121,35 +122,87 @@ class TreeRestore:
                 if target.is_symlink() or target.exists():
                     _rmtree(target)
                 os.symlink(entry["target"], target)
+                _apply_owner(target, entry)
                 _apply_xattrs(target, entry)
                 os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]),
                          follow_symlinks=False)
+            elif entry["type"] == "special":
+                self._restore_special(entry, target, stats)
             elif entry["type"] == "file":
                 if entry.get("hardlink_to"):
                     links.append((entry, target))
                 else:
                     jobs.append((entry, target))
 
+    def _restore_special(self, entry: dict, target: Path, stats: dict):
+        """FIFOs/sockets/device nodes (rsync -D analogue). Device nodes
+        need CAP_MKNOD — without it the node is skipped, the rest of
+        the restore proceeds (the reference's mover logs and continues
+        the same way)."""
+        import stat as stat_mod
+
+        fmt = entry["fmt"]
+        mode = entry["mode"]
+        if target.is_symlink() or target.exists():
+            st = target.lstat()
+            if (stat_mod.S_IFMT(st.st_mode) == fmt
+                    and st.st_rdev == entry.get("rdev", 0)):
+                _apply_xattrs(target, entry)
+                _apply_owner(target, entry)
+                os.chmod(target, mode)
+                os.utime(target,
+                         ns=(entry["mtime_ns"], entry["mtime_ns"]))
+                stats["skipped"] += 1
+                return
+            _rmtree(target)
+        if stat_mod.S_ISFIFO(fmt):
+            os.mkfifo(target, mode)
+        else:
+            try:
+                os.mknod(target, fmt | mode, entry.get("rdev", 0))
+            except PermissionError:
+                # device/socket nodes need CAP_MKNOD; degrade like the
+                # reference mover outside privileged pods. Real IO
+                # errors (EROFS/ENOSPC) still raise.
+                stats["skipped"] += 1
+                return
+        _apply_owner(target, entry)
+        _apply_xattrs(target, entry)
+        os.chmod(target, mode)
+        os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
+        stats["files"] += 1
+
     def _restore_file(self, entry: dict, target: Path) -> tuple[str, int]:
         if (target.is_file() and not target.is_symlink()
                 and target.stat().st_size == entry["size"]
                 and target.stat().st_mtime_ns == entry["mtime_ns"]):
             # Content is trusted unchanged (size+mtime_ns, the same
-            # heuristic backup uses), but mode and xattrs can drift
+            # heuristic backup uses), but owner/mode/xattrs can drift
             # without touching mtime (they update only ctime) —
-            # re-apply both, xattrs first (a read-only final mode
-            # would block setxattr for unprivileged restores).
+            # re-apply all three: xattrs first (a read-only final mode
+            # would block setxattr for unprivileged restores), chown
+            # before chmod (chown clears setuid bits).
             _apply_xattrs(target, entry)
+            _apply_owner(target, entry)
             os.chmod(target, entry["mode"])
             return "skipped", 0
         if target.is_symlink() or target.is_dir():
             _rmtree(target)
-        elif target.exists() and target.lstat().st_nlink > 1:
-            # Break a pre-existing hardlink before writing: an in-place
-            # open("wb") would write through the SHARED inode and
-            # corrupt the other linked path (and race against its own
-            # restore job under the worker pool).
-            target.unlink()
+        elif target.exists():
+            st = target.lstat()
+            import stat as stat_mod
+
+            if not stat_mod.S_ISREG(st.st_mode):
+                # A special occupies the path: opening it "wb" would
+                # block on a reader-less FIFO or write INTO a device
+                # node — remove it first.
+                target.unlink()
+            elif st.st_nlink > 1:
+                # Break a pre-existing hardlink before writing: an
+                # in-place open("wb") would write through the SHARED
+                # inode and corrupt the other linked path (and race
+                # against its own restore job under the worker pool).
+                target.unlink()
         write = _write_sparse if self.sparse else (
             lambda f_, d: f_.write(d))
         with open(target, "wb") as f:
@@ -162,6 +215,7 @@ class TreeRestore:
                 # materialize a trailing hole (seek alone doesn't extend)
                 f.truncate(f.tell())
         _apply_xattrs(target, entry)  # before chmod (read-only modes)
+        _apply_owner(target, entry)   # before chmod (chown clears suid)
         os.chmod(target, entry["mode"])
         os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
         return "files", entry["size"]
@@ -196,6 +250,18 @@ class TreeRestore:
             if gbytes >= self._VERIFY_BATCH:
                 flush()
         flush()
+
+
+def _apply_owner(path, entry: dict) -> None:
+    """uid/gid (rsync -o -g analogue; recorded only-when-nonroot).
+    Unprivileged restores degrade silently — chown needs CAP_CHOWN —
+    matching the reference mover's behavior outside privileged pods."""
+    if "uid" not in entry:
+        return
+    try:
+        os.chown(path, entry["uid"], entry["gid"], follow_symlinks=False)
+    except OSError:
+        pass
 
 
 def _apply_xattrs(path, entry: dict) -> None:
@@ -266,10 +332,12 @@ def _write_sparse(f, data) -> None:
 def _rmtree(path: Path):
     import shutil
 
-    if path.is_symlink() or path.is_file():
-        path.unlink()
-    else:
+    if path.is_dir() and not path.is_symlink():
         shutil.rmtree(path, ignore_errors=True)
+    else:
+        # symlinks, regular files, AND specials (FIFO/socket/device —
+        # is_file() is False for those; rmtree would leave them behind)
+        path.unlink(missing_ok=True)
 
 
 def restore_snapshot(repo: Repository, dest, *,
