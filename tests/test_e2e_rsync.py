@@ -152,3 +152,66 @@ def test_source_requires_address_and_keys(world):
         (cr := cluster.try_get("ReplicationSource", "default", "bad"))
         and cr.status and any(c.reason == "Error"
                               for c in cr.status.conditions)))
+
+
+def test_rsync_plane_fidelity_hardlinks_specials_sparse(tmp_path, rng):
+    """The mover's tree plane carries the full -aAHSD fidelity set:
+    hardlinks, FIFOs/sockets, xattrs, owner, sparse files, dir mtimes."""
+    import os
+    import socket as socket_mod
+    import stat as stat_mod
+
+    from volsync_tpu.movers.rsync import entry
+
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    src.mkdir()
+    dst.mkdir()
+    payload = rng.bytes(80_000)
+    (src / "a.bin").write_bytes(payload)
+    os.link(src / "a.bin", src / "b.bin")
+    os.mkfifo(src / "pipe", 0o640)
+    s = socket_mod.socket(socket_mod.AF_UNIX)
+    s.bind(str(src / "sock"))
+    s.close()
+    os.setxattr(src / "a.bin", "user.tag", b"v1")
+    sub = src / "sub"
+    sub.mkdir()
+    with open(sub / "sparse.img", "wb") as f:
+        f.write(b"x" * 4096)
+        f.seek(8 << 20, os.SEEK_CUR)
+        f.write(b"y" * 4096)
+    if os.geteuid() == 0:
+        os.chown(src / "a.bin", 1234, 5678)
+    dir_mtime = 1_600_000_000_000_000_000
+    os.utime(sub, ns=(dir_mtime, dir_mtime))
+
+    class _Chan:
+        """Loopback channel: dispatch directly into the dest verbs."""
+
+        def __init__(self, verbs):
+            self.verbs = verbs
+            self.reply = None
+
+        def send(self, msg):
+            self.reply = self.verbs[msg["verb"]](msg)
+
+        def recv(self):
+            return self.reply
+
+    ch = _Chan(entry._dest_verbs(dst))
+    entry._push_tree(ch, src)
+
+    assert (dst / "a.bin").read_bytes() == payload
+    assert (dst / "a.bin").stat().st_ino == (dst / "b.bin").stat().st_ino
+    assert stat_mod.S_ISFIFO((dst / "pipe").lstat().st_mode)
+    assert (dst / "pipe").lstat().st_mode & 0o7777 == 0o640
+    assert stat_mod.S_ISSOCK((dst / "sock").lstat().st_mode)
+    assert os.getxattr(dst / "a.bin", "user.tag") == b"v1"
+    if os.geteuid() == 0:
+        st = (dst / "a.bin").stat()
+        assert (st.st_uid, st.st_gid) == (1234, 5678)
+    out = dst / "sub" / "sparse.img"
+    assert out.stat().st_size == 8192 + (8 << 20)
+    assert out.stat().st_blocks * 512 < out.stat().st_size // 2
+    assert (dst / "sub").stat().st_mtime_ns == dir_mtime

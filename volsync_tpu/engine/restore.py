@@ -253,7 +253,9 @@ class TreeRestore:
 
 
 def _apply_owner(path, entry: dict) -> None:
-    """uid/gid (rsync -o -g analogue; recorded only-when-nonroot).
+    """uid/gid (rsync -o -g analogue). Backup records them on EVERY
+    entry (root:root drift must converge too); an ABSENT key means a
+    pre-format snapshot — unknown owner, leave the destination alone.
     Unprivileged restores degrade silently — chown needs CAP_CHOWN —
     matching the reference mover's behavior outside privileged pods."""
     if "uid" not in entry:

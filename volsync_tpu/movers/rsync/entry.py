@@ -34,6 +34,21 @@ MAX_RETRIES = 5  # source.sh:43 (5 attempts, doubling backoff)
 # ---------------------------------------------------------------------------
 
 
+def _apply_meta(path, msg: dict, *, utime: bool = True):
+    """xattrs -> chown -> chmod -> utime (the engine's restore order:
+    xattrs before a possibly-read-only mode; chown clears suid so
+    chmod follows it). Absent keys are skipped — same degrade-to-
+    what-the-wire-carries contract as engine/restore."""
+    from volsync_tpu.engine.restore import _apply_owner, _apply_xattrs
+
+    _apply_xattrs(path, msg)
+    _apply_owner(path, msg)
+    if "mode" in msg:
+        os.chmod(path, msg["mode"])
+    if utime and "mtime_ns" in msg:
+        os.utime(path, ns=(msg["mtime_ns"], msg["mtime_ns"]))
+
+
 def _dest_verbs(root: Path):
     def sig(msg):
         path = _safe_join(root, msg["path"])
@@ -45,6 +60,8 @@ def _dest_verbs(root: Path):
         return {"verb": "sig", "exists": True, **s.to_wire()}
 
     def apply(msg):
+        from volsync_tpu.engine.restore import _write_sparse
+
         path = _safe_join(root, msg["path"])
         old = b""
         if path.is_file() and not path.is_symlink():
@@ -55,9 +72,17 @@ def _dest_verbs(root: Path):
         path.parent.mkdir(parents=True, exist_ok=True)
         if path.is_dir() or path.is_symlink():
             _rm(path)
-        path.write_bytes(new)
-        os.chmod(path, msg["mode"])
-        os.utime(path, ns=(msg["mtime_ns"], msg["mtime_ns"]))
+        elif path.exists() and (
+                not stat_mod.S_ISREG(path.lstat().st_mode)
+                or path.lstat().st_nlink > 1):
+            # a special (writing "into" a FIFO/device is a hang / data
+            # loss) or a hardlinked inode (in-place write would corrupt
+            # the other name) occupies the path — replace, don't reuse
+            path.unlink()
+        with open(path, "wb") as f:
+            _write_sparse(f, new)  # rsync -S semantics
+            f.truncate(len(new))
+        _apply_meta(path, msg)
         return {"verb": "ok", "size": len(new)}
 
     def mkdir(msg):
@@ -65,7 +90,7 @@ def _dest_verbs(root: Path):
         if path.is_symlink() or (path.exists() and not path.is_dir()):
             _rm(path)
         path.mkdir(parents=True, exist_ok=True)
-        os.chmod(path, msg["mode"])
+        os.chmod(path, msg["mode"])  # full meta arrives via dirmeta
         return {"verb": "ok"}
 
     def symlink(msg):
@@ -74,6 +99,58 @@ def _dest_verbs(root: Path):
             _rm(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         os.symlink(msg["target"], path)
+        from volsync_tpu.engine.restore import _apply_owner, _apply_xattrs
+
+        _apply_xattrs(path, msg)
+        _apply_owner(path, msg)
+        if "mtime_ns" in msg:
+            os.utime(path, ns=(msg["mtime_ns"], msg["mtime_ns"]),
+                     follow_symlinks=False)
+        return {"verb": "ok"}
+
+    def link(msg):
+        """Hardlink (rsync -H): target becomes another name of the
+        already-transferred first-sighting path."""
+        path = _safe_join(root, msg["path"])
+        source = _safe_join(root, msg["to"])
+        if path.exists() and not path.is_symlink() \
+                and os.path.samestat(path.lstat(), source.lstat()):
+            return {"verb": "ok"}
+        if path.is_symlink() or path.exists():
+            _rm(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        os.link(source, path)
+        return {"verb": "ok"}
+
+    def special(msg):
+        """FIFO/socket/device nodes (rsync -D)."""
+        path = _safe_join(root, msg["path"])
+        fmt = msg["fmt"]
+        if path.is_symlink() or path.exists():
+            st = path.lstat()
+            if (stat_mod.S_IFMT(st.st_mode) == fmt
+                    and st.st_rdev == msg.get("rdev", 0)):
+                _apply_meta(path, msg)
+                return {"verb": "ok"}
+            _rm(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        if stat_mod.S_ISFIFO(fmt):
+            os.mkfifo(path, msg["mode"])
+        else:
+            try:
+                os.mknod(path, fmt | msg["mode"], msg.get("rdev", 0))
+            except PermissionError:
+                return {"verb": "ok", "skipped": True}  # no CAP_MKNOD
+        _apply_meta(path, msg)
+        return {"verb": "ok"}
+
+    def dirmeta(msg):
+        """Directory metadata, bottom-up AFTER all children are written
+        (a child write would bump the parent's restored mtime)."""
+        for d in msg["dirs"]:
+            path = _safe_join(root, d["path"]) if d["path"] else root
+            if path.is_dir():
+                _apply_meta(path, d)
         return {"verb": "ok"}
 
     def prune(msg):
@@ -90,7 +167,8 @@ def _dest_verbs(root: Path):
         return {"verb": "ok", "removed": removed}
 
     return {"sig": sig, "apply": apply, "mkdir": mkdir,
-            "symlink": symlink, "prune": prune}
+            "symlink": symlink, "link": link, "special": special,
+            "dirmeta": dirmeta, "prune": prune}
 
 
 def serve_destination(root: Path, dst_private: bytes, source_id: str,
@@ -216,9 +294,26 @@ def rsync_source_entrypoint(ctx) -> int:
     return 1
 
 
+def _meta_of(st, p=None) -> dict:
+    """Wire metadata for one node: mode/mtime always, uid/gid always
+    (root:root must converge at the destination too), xattrs
+    only-when-present — mirrors engine/backup's tree-entry contract."""
+    from volsync_tpu.engine.backup import _read_xattrs
+
+    out = {"mode": st.st_mode & 0o7777, "mtime_ns": st.st_mtime_ns,
+           "uid": st.st_uid, "gid": st.st_gid}
+    if p is not None:
+        xs = _read_xattrs(p)
+        if xs:
+            out["xattrs"] = xs
+    return out
+
+
 def _push_tree(ch, root: Path) -> dict:
     stats = {"files": 0, "literal_bytes": 0, "copied_bytes": 0, "bytes": 0}
     keep: list[str] = []
+    dirmeta: list[dict] = []
+    inode_first: dict = {}  # (dev, ino) -> rel (rsync -H)
     for dirpath, dirs, files in os.walk(root):
         dirs.sort()
         for name in sorted(files) + dirs:
@@ -228,15 +323,45 @@ def _push_tree(ch, root: Path) -> dict:
             st = p.lstat()
             if stat_mod.S_ISLNK(st.st_mode):
                 ch.send({"verb": "symlink", "path": rel,
-                         "target": os.readlink(p)})
+                         "target": os.readlink(p), **_meta_of(st, p)})
                 ch.recv()
             elif stat_mod.S_ISDIR(st.st_mode):
                 ch.send({"verb": "mkdir", "path": rel,
                          "mode": st.st_mode & 0o7777})
                 ch.recv()
+                dirmeta.append({"path": rel, **_meta_of(st, p)})
             elif stat_mod.S_ISREG(st.st_mode):
+                if st.st_nlink > 1:
+                    ino = (st.st_dev, st.st_ino)
+                    first = inode_first.get(ino)
+                    if first is not None:
+                        ch.send({"verb": "link", "path": rel,
+                                 "to": first})
+                        ch.recv()
+                        stats["files"] += 1
+                        continue
+                    inode_first[ino] = rel
                 _push_file(ch, p, rel, st, stats)
+            elif stat_mod.S_ISFIFO(st.st_mode) or stat_mod.S_ISSOCK(
+                    st.st_mode) or stat_mod.S_ISBLK(st.st_mode) \
+                    or stat_mod.S_ISCHR(st.st_mode):
+                msg = {"verb": "special", "path": rel,
+                       "fmt": stat_mod.S_IFMT(st.st_mode),
+                       **_meta_of(st, p)}
+                if stat_mod.S_ISBLK(st.st_mode) or stat_mod.S_ISCHR(
+                        st.st_mode):
+                    msg["rdev"] = st.st_rdev
+                ch.send(msg)
+                ch.recv()
     ch.send({"verb": "prune", "paths": keep})
+    ch.recv()
+    # Directory metadata last, children-first (deepest paths first),
+    # with the replication ROOT itself last of all (path "" — rsync -a
+    # with a trailing slash replicates the root dir's meta too):
+    # every write above would have bumped the parent's mtime.
+    dirmeta.sort(key=lambda d: d["path"].count(os.sep), reverse=True)
+    dirmeta.append({"path": "", **_meta_of(root.lstat(), root)})
+    ch.send({"verb": "dirmeta", "dirs": dirmeta})
     ch.recv()
     return stats
 
@@ -254,8 +379,7 @@ def _push_file(ch, path: Path, rel: str, st, stats: dict):
         ops = [("data", data)] if data else []
     wire_ops = [list(op) for op in ops]
     ch.send({"verb": "apply", "path": rel, "ops": wire_ops,
-             "block_len": block_len, "mode": st.st_mode & 0o7777,
-             "mtime_ns": st.st_mtime_ns})
+             "block_len": block_len, **_meta_of(st, path)})
     out = ch.recv()
     if out.get("verb") != "ok":
         raise channel.ChannelError(f"apply failed for {rel}: {out}")
@@ -279,7 +403,12 @@ def _safe_join(root: Path, rel: str) -> Path:
 def _rm(path: Path):
     import shutil
 
-    if path.is_symlink() or path.is_file():
-        path.unlink(missing_ok=True)
-    elif path.is_dir():
+    if path.is_dir() and not path.is_symlink():
         shutil.rmtree(path, ignore_errors=True)
+    else:
+        # symlinks, regular files, AND specials (FIFO/socket/device:
+        # is_file() is False for those — the same fix as
+        # engine/restore._rmtree; a no-op here would make the
+        # replacement verbs raise FileExistsError and prune leave
+        # stale specials behind while still counting them removed)
+        path.unlink(missing_ok=True)
