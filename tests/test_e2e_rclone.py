@@ -352,3 +352,35 @@ def test_sharded_index_missing_shard_is_error(tmp_path):
         {"version": 2, "shards": {"ab": "ab-deadbeef.json"}}).encode())
     with pytest.raises(SyncError, match="shard"):
         read_index(store, "p")
+
+
+def test_mirror_carries_owner_and_xattrs(world, rng, tmp_path):
+    """The metadata index is the reference's getfacl-dump analogue:
+    owner + ACL-carrier xattrs round-trip through the bucket mirror."""
+    import os
+
+    from volsync_tpu.movers.rclone.sync import sync_down, sync_up
+    from volsync_tpu.objstore import MemObjectStore
+
+    src = tmp_path / "srcvol"
+    dst = tmp_path / "dstvol"
+    src.mkdir()
+    dst.mkdir()
+    f = src / "f.bin"
+    f.write_bytes(rng.bytes(40_000))
+    os.setxattr(f, "user.acltag", b"rwx")
+    if os.geteuid() == 0:
+        os.chown(f, 4321, 8765)
+    sub = src / "sub"
+    sub.mkdir()
+    os.setxattr(sub, "user.dirtag", b"d")
+
+    store = MemObjectStore()
+    sync_up(src, store, "pfx")
+    sync_down(store, "pfx", dst)
+
+    assert os.getxattr(dst / "f.bin", "user.acltag") == b"rwx"
+    assert os.getxattr(dst / "sub", "user.dirtag") == b"d"
+    if os.geteuid() == 0:
+        st = (dst / "f.bin").stat()
+        assert (st.st_uid, st.st_gid) == (4321, 8765)

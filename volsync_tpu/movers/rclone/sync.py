@@ -31,6 +31,7 @@ from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 from volsync_tpu.engine.chunker import hash_file_streaming, hash_spans
+from volsync_tpu.engine.restore import _apply_owner, _apply_xattrs
 from volsync_tpu.objstore.store import (
     NoSuchKey,
     ObjectStore,
@@ -159,9 +160,26 @@ def _validated_entries(entries: dict) -> dict:
     return entries
 
 
-def scan_tree(root: Path) -> dict[str, dict]:
+def _owner_xattrs(st, p) -> dict:
+    """uid/gid + xattrs for the metadata index — the reference rclone
+    mover's `getfacl -R` dump analogue (active.sh:24), which records
+    owner and ACLs; ACLs travel inside system.posix_acl_* xattrs.
+    ``xattrs`` is ALWAYS present (possibly {}) in this index format:
+    removing the last xattr at the source must strip it at the
+    destination too (pre-format indexes are recognized by the absent
+    uid key and left alone)."""
+    from volsync_tpu.engine.backup import _read_xattrs
+
+    return {"uid": st.st_uid, "gid": st.st_gid,
+            "xattrs": _read_xattrs(p)}
+
+
+def scan_tree(root: Path, *, collect_meta: bool = True) -> dict[str, dict]:
     """Walk a volume -> {relpath: entry} with file metadata (no digests
-    yet). Sockets/devices are skipped, as the reference movers do."""
+    yet). Sockets/devices are skipped, as the reference movers do.
+    ``collect_meta=False`` skips the owner/xattr syscalls — for scans
+    used only for membership/type/size (sync_down's local inventory)."""
+    meta = _owner_xattrs if collect_meta else (lambda st, p: {})
     entries: dict[str, dict] = {}
     root = Path(root)
     for dirpath, dirnames, filenames in os.walk(root):
@@ -170,18 +188,20 @@ def scan_tree(root: Path) -> dict[str, dict]:
         if rel_dir != ".":
             st = d.lstat()
             entries[rel_dir] = {"type": "dir", "mode": st.st_mode & 0o7777,
-                                "mtime_ns": st.st_mtime_ns}
+                                "mtime_ns": st.st_mtime_ns,
+                                **meta(st, d)}
         for name in filenames:
             p = d / name
             st = p.lstat()
             rel = p.relative_to(root).as_posix()
             if stat_mod.S_ISLNK(st.st_mode):
                 entries[rel] = {"type": "symlink",
-                                "target": os.readlink(p)}
+                                "target": os.readlink(p), **meta(st, p)}
             elif stat_mod.S_ISREG(st.st_mode):
                 entries[rel] = {"type": "file", "size": st.st_size,
                                 "mode": st.st_mode & 0o7777,
-                                "mtime_ns": st.st_mtime_ns}
+                                "mtime_ns": st.st_mtime_ns,
+                                **meta(st, p)}
         # symlinked dirs: record as symlink, don't descend
         for name in list(dirnames):
             p = d / name
@@ -411,7 +431,7 @@ def sync_down(store: ObjectStore, prefix: str, root: Path, *,
             f"no index at {prefix!r}: nothing has been synced here")
     entries = _validated_entries(got)
 
-    local = scan_tree(root)
+    local = scan_tree(root, collect_meta=False)
     local_files = [r for r, e in local.items() if e["type"] == "file"
                    and r in entries and entries[r]["type"] == "file"
                    and entries[r]["size"] == e["size"]]
@@ -488,13 +508,23 @@ def sync_down(store: ObjectStore, prefix: str, root: Path, *,
                     p.unlink()
             p.parent.mkdir(parents=True, exist_ok=True)
             os.symlink(entry["target"], p)
+            _apply_xattrs(p, entry)
+            _apply_owner(p, entry)
         elif entry["type"] == "file":
+            # xattrs before chmod (read-only modes block setxattr),
+            # chown before chmod (chown clears suid) — the engine
+            # restore's ordering; the index carries the facl-dump
+            # analogue (owner + ACL xattrs)
+            _apply_xattrs(p, entry)
+            _apply_owner(p, entry)
             os.chmod(p, entry["mode"])
             os.utime(p, ns=(entry["mtime_ns"], entry["mtime_ns"]))
     # dir metadata last (child writes bump parent mtimes), deepest first
     for rel in sorted((r for r, e in entries.items() if e["type"] == "dir"),
                       key=len, reverse=True):
         entry = entries[rel]
+        _apply_xattrs(root / rel, entry)
+        _apply_owner(root / rel, entry)
         os.chmod(root / rel, entry["mode"])
         os.utime(root / rel, ns=(entry["mtime_ns"], entry["mtime_ns"]))
     return {"files": sum(1 for e in entries.values() if e["type"] == "file"),
