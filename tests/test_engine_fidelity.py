@@ -244,11 +244,11 @@ def test_special_replaced_by_file_between_snapshots(tmp_path, rng):
     assert (dst / "x").read_bytes() == payload
 
 
-def test_write_sparse_property(rng):
+def test_write_sparse_property(rng, tmp_path):
     """_write_sparse must reproduce EXACT bytes for arbitrary
-    compositions of zero runs and data, at every alignment."""
-    import io
-
+    compositions of zero runs and data, at every alignment. Uses a
+    real file: BytesIO.truncate does NOT zero-extend past EOF the way
+    ftruncate does, so it cannot model the trailing-hole contract."""
     from volsync_tpu.engine.restore import _write_sparse
 
     cases = [
@@ -270,8 +270,9 @@ def test_write_sparse_property(rng):
             else:
                 parts.append(rng.bytes(int(rng.randint(1, 9000))))
         cases.append(b"".join(parts))
+    target = tmp_path / "sparse_case"
     for data in cases:
-        f = io.BytesIO()
-        _write_sparse(f, data)
-        f.truncate(len(data))  # the caller's trailing-hole truncate
-        assert f.getvalue() == data, len(data)
+        with open(target, "wb") as f:
+            _write_sparse(f, data)
+            f.truncate(len(data))  # the caller's trailing-hole truncate
+        assert target.read_bytes() == data, len(data)
