@@ -193,6 +193,19 @@ def scenario_dedup(total_gib: float, redundancy: float = 0.5) -> dict:
         # every chunk); allow a tiny margin for the open pack.
         assert s["bytes_dedup"] >= dup_target * 0.999, (s, dup_target)
         ratio = s["bytes_scanned"] / max(s["bytes_new"], 1)
+
+        # Restore leg: the same volume back out, spot-verified (full
+        # byte compare of first/repeated/last pieces; the engine's
+        # device-verify tier covers per-blob integrity elsewhere).
+        from volsync_tpu.engine import restore_snapshot
+
+        dst = tmp / "restore"
+        t1 = time.perf_counter()
+        restore_snapshot(Repository.open(FsObjectStore(tmp / "repo")), dst)
+        rt = time.perf_counter() - t1
+        for i in sorted({0, min(n_unique, n_pieces - 1), n_pieces - 1}):
+            want = (src / f"f{i:03d}.bin").read_bytes()
+            assert (dst / f"f{i:03d}.bin").read_bytes() == want, i
         return {
             "metric": "dedup_volume_backup",
             "gib": round(total / (1 << 30), 2),
@@ -202,6 +215,8 @@ def scenario_dedup(total_gib: float, redundancy: float = 0.5) -> dict:
             "bytes_dedup": s["bytes_dedup"],
             "wall_s": round(dt, 1),
             "mib_s": round(total / dt / (1 << 20), 1),
+            "restore_wall_s": round(rt, 1),
+            "restore_mib_s": round(total / rt / (1 << 20), 1),
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
