@@ -182,17 +182,28 @@ def scan_tree(root: Path, *, collect_meta: bool = True) -> dict[str, dict]:
     meta = _owner_xattrs if collect_meta else (lambda st, p: {})
     entries: dict[str, dict] = {}
     root = Path(root)
+    # --one-file-system (active.sh:19). stat(), not lstat(): a
+    # symlinked volume root must anchor at the walked filesystem or the
+    # whole inventory reads as foreign (and a later mirror pass would
+    # delete real data from the empty index).
+    root_dev = root.stat().st_dev
     for dirpath, dirnames, filenames in os.walk(root):
         d = Path(dirpath)
         rel_dir = d.relative_to(root).as_posix()
         if rel_dir != ".":
             st = d.lstat()
+            if st.st_dev != root_dev:
+                # mount point: record as an empty dir, don't descend
+                dirnames.clear()
+                filenames = []
             entries[rel_dir] = {"type": "dir", "mode": st.st_mode & 0o7777,
                                 "mtime_ns": st.st_mtime_ns,
                                 **meta(st, d)}
         for name in filenames:
             p = d / name
             st = p.lstat()
+            if st.st_dev != root_dev:
+                continue  # foreign device (bind-mounted file)
             rel = p.relative_to(root).as_posix()
             if stat_mod.S_ISLNK(st.st_mode):
                 entries[rel] = {"type": "symlink",

@@ -37,15 +37,37 @@ def box_from_key(key: bytes) -> SecretBox:
     return SecretBox(enc, mac)
 
 
+#: Frames above this compress before sealing (rsync -z analogue;
+#: mover-rsync/source.sh:54). Small control frames skip the overhead.
+_COMPRESS_MIN = 1024
+_FLAG_RAW = b"\x00"
+_FLAG_ZSTD = b"\x01"
+
+
 class Framed:
-    """Sealed, length-prefixed msgpack frames over a socket."""
+    """Sealed, length-prefixed msgpack frames over a socket.
+
+    Plaintext layout (inside the seal): 1 flag byte (0 raw / 1 zstd)
+    then the msgpack body — compress-then-encrypt, the rsync -z
+    analogue. Compression is applied only when it actually shrinks the
+    body (already-compressed file data falls back to raw)."""
 
     def __init__(self, sock: socket.socket, box: SecretBox):
         self.sock = sock
         self.box = box
+        import zstandard
+
+        self._c = zstandard.ZstdCompressor(level=3)
+        self._d = zstandard.ZstdDecompressor()
 
     def send(self, obj) -> None:
-        payload = self.box.seal(msgpack.packb(obj, use_bin_type=True))
+        body = msgpack.packb(obj, use_bin_type=True)
+        plain = _FLAG_RAW + body
+        if len(body) >= _COMPRESS_MIN:
+            z = self._c.compress(body)
+            if len(z) < len(body):
+                plain = _FLAG_ZSTD + z
+        payload = self.box.seal(plain)
         self.sock.sendall(struct.pack(">I", len(payload)) + payload)
 
     def recv(self):
@@ -57,7 +79,22 @@ class Framed:
             plain = self.box.open(self._read_exact(n))
         except IntegrityError as e:
             raise ChannelError(f"authentication failure: {e}") from None
-        return msgpack.unpackb(plain, raw=False)
+        if not plain:
+            raise ChannelError("empty frame")
+        flag, body = plain[:1], plain[1:]
+        if flag == _FLAG_ZSTD:
+            import zstandard
+
+            try:
+                # bound decompressed size: a corrupt or oversized frame
+                # must not OOM us (the peer is inside the auth envelope)
+                body = self._d.decompress(body,
+                                          max_output_size=_MAX_FRAME)
+            except zstandard.ZstdError as e:
+                raise ChannelError(f"bad compressed frame: {e}") from None
+        elif flag != _FLAG_RAW:
+            raise ChannelError(f"unknown frame flag: {flag!r}")
+        return msgpack.unpackb(body, raw=False)
 
     def _read_exact(self, n: int) -> bytes:
         buf = b""

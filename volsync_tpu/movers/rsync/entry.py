@@ -314,13 +314,25 @@ def _push_tree(ch, root: Path) -> dict:
     keep: list[str] = []
     dirmeta: list[dict] = []
     inode_first: dict = {}  # (dev, ino) -> rel (rsync -H)
+    # rsync -x: one file system. stat(), not lstat(): a SYMLINKED
+    # replication root (mount indirection) must anchor the device id at
+    # the walk's actual filesystem, or every entry looks foreign and
+    # prune would wipe the destination.
+    root_dev = root.stat().st_dev
     for dirpath, dirs, files in os.walk(root):
         dirs.sort()
         for name in sorted(files) + dirs:
             p = Path(dirpath, name)
             rel = str(p.relative_to(root))
-            keep.append(rel)
             st = p.lstat()
+            if st.st_dev != root_dev:
+                # -x semantics: a mount point appears as an EMPTY dir
+                # (created below if a dir), its contents never cross
+                if stat_mod.S_ISDIR(st.st_mode):
+                    dirs.remove(name)  # don't descend
+                else:
+                    continue  # foreign non-dir: skip entirely
+            keep.append(rel)
             if stat_mod.S_ISLNK(st.st_mode):
                 ch.send({"verb": "symlink", "path": rel,
                          "target": os.readlink(p), **_meta_of(st, p)})
