@@ -180,3 +180,55 @@ def test_service_microbatches_concurrent_streams(rng):
         assert got == want
     # concurrency actually coalesced: at least one multi-lane dispatch
     assert any(s > 1 for s in batch_sizes), batch_sizes
+
+
+def test_channel_rejects_malformed_frames(rng):
+    """Adversarial frames at the sealed-channel decoder: wrong flag,
+    corrupt zstd body, truncated seal — every shape must surface as
+    ChannelError, never an unhandled exception type."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    import pytest
+
+    from volsync_tpu.movers.rsync import channel
+
+    key = b"q" * 32
+    box = channel.box_from_key(key)
+
+    def framed_pair():
+        a, b = socket_mod.socketpair()
+        return a, channel.Framed(b, box)
+
+    # unknown flag byte inside a valid seal
+    a, fb = framed_pair()
+    payload = box.seal(b"\x07" + b"junk")
+    a.sendall(struct_mod.pack(">I", len(payload)) + payload)
+    with pytest.raises(channel.ChannelError, match="unknown frame flag"):
+        fb.recv()
+    a.close()
+
+    # zstd flag with garbage body
+    a, fb = framed_pair()
+    payload = box.seal(channel._FLAG_ZSTD + rng.bytes(64))
+    a.sendall(struct_mod.pack(">I", len(payload)) + payload)
+    with pytest.raises(channel.ChannelError, match="bad compressed"):
+        fb.recv()
+    a.close()
+
+    # empty plaintext
+    a, fb = framed_pair()
+    payload = box.seal(b"")
+    a.sendall(struct_mod.pack(">I", len(payload)) + payload)
+    with pytest.raises(channel.ChannelError, match="empty frame"):
+        fb.recv()
+    a.close()
+
+    # bit-flipped seal (authentication failure)
+    a, fb = framed_pair()
+    payload = bytearray(box.seal(b"\x00" + b"hi"))
+    payload[-1] ^= 0xFF
+    a.sendall(struct_mod.pack(">I", len(payload)) + bytes(payload))
+    with pytest.raises(channel.ChannelError, match="authentication"):
+        fb.recv()
+    a.close()
