@@ -241,3 +241,37 @@ def test_batching_default_follows_backend(monkeypatch):
     assert bm._batching_enabled() is False
     monkeypatch.setenv("VOLSYNC_BATCH_SEGMENTS", "false")
     assert bm._batching_enabled() is False
+
+
+def test_treebackup_batched_plus_device_verified_restore(tmp_path,
+                                                         monkeypatch):
+    """Feature interaction guard: the shared micro-batcher (batched
+    dispatches) composing with device-batched restore verification —
+    snapshot bit-identity and a verified restore in one flow."""
+    from volsync_tpu.engine import TreeBackup, restore_snapshot
+    from volsync_tpu.objstore import MemObjectStore
+    from volsync_tpu.ops import batcher as batcher_mod
+    from volsync_tpu.repo.repository import Repository
+
+    rng = np.random.RandomState(77)
+    src = tmp_path / "src"
+    src.mkdir()
+    for i in range(4):
+        (src / f"f{i}.bin").write_bytes(rng.bytes(120_000 + i * 9000))
+
+    chunker_cfg = {"min_size": P.min_size, "avg_size": P.avg_size,
+                   "max_size": P.max_size, "seed": P.seed, "align": 4096}
+    monkeypatch.setenv("VOLSYNC_BATCH_SEGMENTS", "1")
+    monkeypatch.setenv("VOLSYNC_DEVICE_VERIFY", "1")
+    monkeypatch.setattr(batcher_mod, "_SHARED", {})
+    repo = Repository.init(MemObjectStore(), chunker=chunker_cfg)
+    try:
+        snap, _ = TreeBackup(repo, workers=3).run(src)
+        dst = tmp_path / "dst"
+        restore_snapshot(repo, dst)
+    finally:
+        for b in batcher_mod._SHARED.values():
+            b.stop()
+    for i in range(4):
+        assert (dst / f"f{i}.bin").read_bytes() \
+            == (src / f"f{i}.bin").read_bytes()
