@@ -258,6 +258,10 @@ def test_treebackup_batched_plus_device_verified_restore(tmp_path,
     src.mkdir()
     for i in range(4):
         (src / f"f{i}.bin").write_bytes(rng.bytes(120_000 + i * 9000))
+    # zero-heavy file: exercises the SPARSE writer inside the
+    # device-verified restore path (holes + verification together)
+    (src / "holes.bin").write_bytes(
+        rng.bytes(8192) + bytes(300_000) + rng.bytes(4096))
 
     chunker_cfg = {"min_size": P.min_size, "avg_size": P.avg_size,
                    "max_size": P.max_size, "seed": P.seed, "align": 4096}
@@ -275,3 +279,5 @@ def test_treebackup_batched_plus_device_verified_restore(tmp_path,
     for i in range(4):
         assert (dst / f"f{i}.bin").read_bytes() \
             == (src / f"f{i}.bin").read_bytes()
+    assert (dst / "holes.bin").read_bytes() \
+        == (src / "holes.bin").read_bytes()
