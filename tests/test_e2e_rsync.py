@@ -287,3 +287,72 @@ def test_one_file_system_x(tmp_path, rng):
         assert not (dst / "mnt" / "foreign.txt").exists()
     finally:
         subprocess.run(["umount", str(mnt)], capture_output=True)
+
+
+def test_rsync_cr_path_preserves_fidelity(world, rng):
+    """Fidelity through the full rsync CR path (destination listener +
+    source push Jobs): hardlinks, xattrs, and a sparse file arrive
+    intact at the replicated volume."""
+    import os
+    import pathlib
+
+    cluster = world
+    src_vol = make_volume(cluster, "fid-src", {"base.bin": rng.bytes(90_000)})
+    root = pathlib.Path(src_vol.status.path)
+    os.link(root / "base.bin", root / "base_link.bin")
+    os.setxattr(root / "base.bin", "user.app", b"db")
+    with open(root / "disk.img", "wb") as f:
+        f.write(b"H" * 4096)
+        f.seek(5 << 20, os.SEEK_CUR)
+        f.write(b"T" * 4096)
+
+    rd = ReplicationDestination(
+        metadata=ObjectMeta(name="fid-dst", namespace="default"),
+        spec=ReplicationDestinationSpec(
+            trigger=ReplicationTrigger(manual="one"),
+            rsync=ReplicationDestinationRsyncSpec(
+                copy_method=CopyMethod.SNAPSHOT),
+        ),
+    )
+    cluster.create(rd)
+    wait(cluster, lambda: (
+        (cr := cluster.try_get("ReplicationDestination", "default",
+                               "fid-dst"))
+        and cr.status and cr.status.rsync
+        and cr.status.rsync.address and cr.status.rsync.port))
+    cr = cluster.get("ReplicationDestination", "default", "fid-dst")
+
+    rs = ReplicationSource(
+        metadata=ObjectMeta(name="fid-src-cr", namespace="default"),
+        spec=ReplicationSourceSpec(
+            source_pvc="fid-src",
+            trigger=ReplicationTrigger(manual="one"),
+            rsync=ReplicationSourceRsyncSpec(
+                address=cr.status.rsync.address,
+                port=cr.status.rsync.port,
+                ssh_keys=cr.status.rsync.ssh_keys,
+                copy_method=CopyMethod.CLONE),
+        ),
+    )
+    cluster.create(rs)
+    wait(cluster, lambda: (
+        (c := cluster.try_get("ReplicationSource", "default",
+                              "fid-src-cr"))
+        and c.status and c.status.last_manual_sync == "one"))
+    wait(cluster, lambda: (
+        (c := cluster.try_get("ReplicationDestination", "default",
+                              "fid-dst"))
+        and c.status and c.status.latest_image is not None))
+
+    cr = cluster.get("ReplicationDestination", "default", "fid-dst")
+    snap = cluster.get("VolumeSnapshot", "default",
+                       cr.status.latest_image.name)
+    restored = pathlib.Path(snap.status.bound_content)
+    assert (restored / "base.bin").read_bytes() \
+        == (root / "base.bin").read_bytes()
+    assert (restored / "base.bin").stat().st_ino \
+        == (restored / "base_link.bin").stat().st_ino
+    assert os.getxattr(restored / "base.bin", "user.app") == b"db"
+    sp = restored / "disk.img"
+    assert sp.stat().st_size == 8192 + (5 << 20)
+    assert sp.stat().st_blocks * 512 < sp.stat().st_size // 2
