@@ -384,3 +384,19 @@ def test_mirror_carries_owner_and_xattrs(world, rng, tmp_path):
     if os.geteuid() == 0:
         st = (dst / "f.bin").stat()
         assert (st.st_uid, st.st_gid) == (4321, 8765)
+
+
+def test_rclone_cr_path_preserves_metadata(world, rng):
+    """xattrs and owner metadata through the full rclone CR path
+    (source mirror -> bucket -> destination mirror)."""
+    import os
+
+    cluster, tmp_path = world
+    vol = make_volume(cluster, "app-data", {"cfg.bin": rng.bytes(50_000)})
+    root = pathlib.Path(vol.status.path)
+    os.setxattr(root / "cfg.bin", "user.role", b"primary")
+    rclone_secret(cluster, tmp_path)
+
+    _sync_source(cluster, "m1", name="fid-up")
+    image = _sync_destination(cluster, "m1", name="fid-down")
+    assert os.getxattr(image / "cfg.bin", "user.role") == b"primary"
