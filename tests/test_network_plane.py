@@ -232,3 +232,83 @@ def test_channel_rejects_malformed_frames(rng):
     with pytest.raises(channel.ChannelError, match="authentication"):
         fb.recv()
     a.close()
+
+
+def test_channel_version_negotiation():
+    """A mixed-version source/destination pair must fail with an
+    EXPLICIT version-mismatch error, not an opaque msgpack/unknown-flag
+    failure mid-sync: the hello/hello-ack carry CHANNEL_VERSION and a
+    mismatched hello draws a version-mismatch refusal."""
+    import socket as socket_mod
+    import threading
+
+    from volsync_tpu.movers.rsync import channel
+
+    key = b"v" * 32
+
+    # Same-version pair handshakes fine through the public entry points.
+    srv = socket_mod.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    rc_holder = {}
+
+    def serve_one():
+        conn, _ = srv.accept()
+        rc_holder["rc"] = channel.serve_session(conn, key, {})
+
+    t = threading.Thread(target=serve_one)
+    t.start()
+    ch = channel.client_connect("127.0.0.1", port, key)
+    ch.send({"verb": "shutdown", "rc": 0})
+    assert ch.recv() == {"verb": "ok"}
+    t.join(timeout=10)
+    assert rc_holder["rc"] == 0
+
+    # An old-version client is refused BEFORE any sealed frame: the
+    # preamble layout is version-independent, so this works even
+    # across framing changes (the whole point of the mechanism).
+    import struct as struct_mod
+
+    def serve_two():
+        conn, _ = srv.accept()
+        rc_holder["rc2"] = channel.serve_session(conn, key, {})
+
+    t = threading.Thread(target=serve_two)
+    t.start()
+    def read_exact(s, n):
+        buf = b""
+        while len(buf) < n:
+            piece = s.recv(n - len(buf))
+            if not piece:
+                break
+            buf += piece
+        return buf
+
+    sock = socket_mod.create_connection(("127.0.0.1", port), timeout=10)
+    sock.settimeout(10)
+    sock.sendall(b"VSCH" + struct_mod.pack(
+        ">I", channel.CHANNEL_VERSION - 1))
+    peer = read_exact(sock, 8)  # server's preamble still arrives readable
+    assert peer[:4] == b"VSCH"
+    assert struct_mod.unpack(">I", peer[4:])[0] == channel.CHANNEL_VERSION
+    assert sock.recv(1) == b""  # then the server hangs up
+    sock.close()
+    t.join(timeout=10)
+    assert rc_holder["rc2"] is None
+
+    # Client side: a future-version server draws an explicit
+    # version-mismatch ChannelError, not an opaque framing failure.
+    import pytest
+
+    def serve_future():
+        conn, _ = srv.accept()
+        conn.sendall(b"VSCH" + struct_mod.pack(
+            ">I", channel.CHANNEL_VERSION + 1))
+        conn.recv(8)
+        conn.close()
+
+    t = threading.Thread(target=serve_future)
+    t.start()
+    with pytest.raises(channel.ChannelError, match="version mismatch"):
+        channel.client_connect("127.0.0.1", port, key)
+    t.join(timeout=10)
+    srv.close()

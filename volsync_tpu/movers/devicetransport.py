@@ -30,7 +30,8 @@ from typing import Optional
 
 import msgpack
 
-from volsync_tpu.movers.rsync.channel import ChannelError, Framed, box_from_key
+from volsync_tpu.movers.rsync.channel import (CHANNEL_VERSION, ChannelError,
+                                              Framed, box_from_key)
 
 # RFC 3526 group 14 (2048-bit MODP): a public, fixed DH group.
 DH_P = int(
@@ -114,8 +115,16 @@ def connect_device(address: str, port: int, private: bytes,
     plain = PlainFramed(sock)
     my_pub = public_key(private)
     nonce = os.urandom(16)
-    plain.send({"pub": my_pub, "nonce": nonce})
+    plain.send({"pub": my_pub, "nonce": nonce, "v": CHANNEL_VERSION})
     hello = plain.recv()
+    if hello.get("v") != CHANNEL_VERSION:
+        # Version rides the CLEARTEXT hello so a mixed-version pair
+        # fails here with an explicit error, before either side tries
+        # to parse the other's sealed framing.
+        sock.close()
+        raise ChannelError(
+            f"device channel version mismatch: local v{CHANNEL_VERSION}, "
+            f"peer v{hello.get('v')}")
     peer_pub, peer_nonce = hello.get("pub"), hello.get("nonce")
     if not isinstance(peer_pub, bytes) or not isinstance(peer_nonce, bytes):
         sock.close()
@@ -155,8 +164,21 @@ def accept_device(conn: socket.socket, private: bytes,
             # certs not in its config the same way).
             conn.close()
             return None
+        if hello.get("v") != CHANNEL_VERSION:
+            # Reply with OUR hello (it carries our version) before
+            # hanging up, so the dialer's version check reports the
+            # explicit mismatch instead of "peer closed".
+            try:
+                plain.send({"pub": public_key(private),
+                            "nonce": os.urandom(16),
+                            "v": CHANNEL_VERSION})
+            except OSError:
+                pass
+            conn.close()
+            return None
         my_nonce = os.urandom(16)
-        plain.send({"pub": public_key(private), "nonce": my_nonce})
+        plain.send({"pub": public_key(private), "nonce": my_nonce,
+                    "v": CHANNEL_VERSION})
         shared = pow(int.from_bytes(peer_pub, "big"),
                      int.from_bytes(private, "big"), DH_P)
         ch = Framed(conn,

@@ -219,7 +219,8 @@ def scan_tree(root: Path, *, collect_meta: bool = True) -> dict[str, dict]:
             if p.is_symlink():
                 dirnames.remove(name)
                 entries[p.relative_to(root).as_posix()] = {
-                    "type": "symlink", "target": os.readlink(p)}
+                    "type": "symlink", "target": os.readlink(p),
+                    **meta(p.lstat(), p)}
     return entries
 
 

@@ -25,6 +25,51 @@ from volsync_tpu.repo.crypto import IntegrityError, SecretBox
 
 _MAX_FRAME = 256 * 1024 * 1024
 
+#: Wire-format generation of the sealed framing. v2 added the
+#: raw/zstd flag byte inside the seal; the version is exchanged in a
+#: fixed-format CLEARTEXT preamble (below) so a mixed-version
+#: source/destination pair (rolling operator upgrade) fails with an
+#: explicit version-mismatch error instead of an opaque
+#: msgpack/unknown-flag failure mid-sync — the preamble layout is
+#: frozen, so the check works across any framing change from v2
+#: onward (peers older than the preamble itself are diagnosed as
+#: "pre-v2 peer"). Bump on any framing change. The preamble carries no
+#: secrets; tampering with it can only refuse a connection (DoS-
+#: equivalent to dropping packets), never weaken the sealed channel.
+CHANNEL_VERSION = 2
+_PREAMBLE_MAGIC = b"VSCH"
+_PREAMBLE_LEN = 8  # magic + >I version — FROZEN for all versions
+
+
+def _preamble() -> bytes:
+    return _PREAMBLE_MAGIC + struct.pack(">I", CHANNEL_VERSION)
+
+
+def _exchange_preamble(ch: "Framed") -> int:
+    """Both sides write the 8-byte cleartext preamble immediately on
+    connect (no deadlock) and read the peer's; returns the peer's
+    version. The layout is frozen, so this works across any framing
+    change from v2 onward; a peer that predates the preamble entirely
+    (or a non-volsync client) draws an explicit ChannelError — the
+    best possible diagnosis, since such a peer speaks no preamble we
+    could negotiate with."""
+    ch.sock.sendall(_preamble())
+    try:
+        peer = ch._read_exact(_PREAMBLE_LEN)
+    except ChannelError:
+        # A pre-preamble peer misparses our magic as a frame header
+        # (~1.4 GB length), errors out and hangs up without writing —
+        # diagnose that instead of reporting the bare EOF.
+        raise ChannelError(
+            "peer hung up during the version preamble exchange "
+            "(pre-v2 peer, or not a volsync channel)") from None
+    if peer[:4] != _PREAMBLE_MAGIC:
+        raise ChannelError(
+            "peer sent no version preamble (pre-v2 peer or not a "
+            "volsync channel)")
+    (peer_v,) = struct.unpack(">I", peer[4:])
+    return peer_v
+
 
 class ChannelError(RuntimeError):
     pass
@@ -93,8 +138,16 @@ class Framed:
             except zstandard.ZstdError as e:
                 raise ChannelError(f"bad compressed frame: {e}") from None
         elif flag != _FLAG_RAW:
-            raise ChannelError(f"unknown frame flag: {flag!r}")
-        return msgpack.unpackb(body, raw=False)
+            raise ChannelError(
+                f"unknown frame flag: {flag!r} (peer running an "
+                f"incompatible channel version? local v{CHANNEL_VERSION})")
+        try:
+            return msgpack.unpackb(body, raw=False)
+        except Exception as e:  # msgpack's error zoo is not one type
+            raise ChannelError(
+                f"malformed frame body (peer running an incompatible "
+                f"channel version? local v{CHANNEL_VERSION}): {e}"
+            ) from None
 
     def _read_exact(self, n: int) -> bytes:
         buf = b""
@@ -117,6 +170,22 @@ def client_connect(address: str, port: int, key: bytes,
     sock = socket.create_connection((address, port), timeout=timeout)
     sock.settimeout(timeout)
     ch = Framed(sock, box_from_key(key))
+    # Cleartext version preamble BEFORE any sealed frame, so mismatched
+    # peers never have to parse each other's version-dependent framing.
+    try:
+        peer_v = _exchange_preamble(ch)
+        if peer_v != CHANNEL_VERSION:
+            raise ChannelError(
+                f"channel version mismatch: local v{CHANNEL_VERSION}, "
+                f"peer v{peer_v}")
+    except ChannelError:
+        ch.close()
+        raise
+    except OSError as e:
+        # socket.timeout / ECONNRESET from a half-open or hung peer:
+        # close the fd and surface the ChannelError callers expect.
+        ch.close()
+        raise ChannelError(f"preamble exchange failed: {e}") from None
     nonce = os.urandom(16)
     ch.send({"verb": "hello", "nonce": nonce})
     reply = ch.recv()  # decrypting proves the server holds the key
@@ -160,12 +229,20 @@ def serve_session(conn: socket.socket, key: bytes,
     conn.settimeout(timeout)
     ch = Framed(conn, box_from_key(key))
     try:
+        # Cleartext preamble exchange (see _exchange_preamble): version
+        # mismatch hangs up here, before either side parses the
+        # other's sealed framing. OSError covers a peer that RSTs
+        # mid-handshake (port scanner, crashed mover) — the listener's
+        # handler thread must survive it.
+        if _exchange_preamble(ch) != CHANNEL_VERSION:
+            ch.close()
+            return None
         hello = ch.recv()  # MAC-validated: proves the client holds the key
         if hello.get("verb") != "hello":
             ch.close()
             return None
         ch.send({"verb": "hello-ack", "nonce": hello.get("nonce")})
-    except ChannelError:
+    except (ChannelError, OSError):
         ch.close()
         return None
     return serve_channel(ch, verbs)

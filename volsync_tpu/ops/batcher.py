@@ -92,7 +92,31 @@ class SegmentMicroBatcher:
                     batch.append(self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
-            self._inflight.acquire()
+            # Interruptible slot wait: if every dispatch slot stays
+            # occupied for 30 s AFTER stop() fires (the same bound
+            # stop() grants in-flight dispatches — a healthy-but-slow
+            # pipeline frees a slot well within it), the pipeline is
+            # wedged: fail the in-hand batch instead of blocking
+            # forever with popped futures that stop()'s queue drain
+            # can no longer reach.
+            acquired = stop_deadline = None
+            while True:
+                if self._inflight.acquire(timeout=0.2):
+                    acquired = True
+                    break
+                if not self._stop.is_set():
+                    continue
+                now = time_mod.monotonic()
+                if stop_deadline is None:
+                    stop_deadline = now + 30.0
+                elif now >= stop_deadline:
+                    break
+            if not acquired:
+                exc = RuntimeError("microbatcher stopped")
+                for _, _, _, f in batch:
+                    if not f.done():
+                        f.set_exception(exc)
+                return
             self._dq.put(batch)
 
     def _dispatch_loop(self):

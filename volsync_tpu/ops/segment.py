@@ -101,9 +101,9 @@ def _use_pagemajor() -> bool:
     measures both via the word_index override) proves it; the mesh
     path always stays word-major (its cross-shard word_index assumes
     the per-shard kernel layout)."""
-    import os
+    from volsync_tpu.envflags import env_bool
 
-    return bool(os.environ.get("VOLSYNC_PAGEMAJOR"))
+    return env_bool("VOLSYNC_PAGEMAJOR")
 
 
 def _word_index_fn(n_pages_pad: int, pagemajor: bool):
