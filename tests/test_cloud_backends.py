@@ -3,9 +3,10 @@
 The reference's restic mover passes the AWS/B2/Azure/GCS/Swift env
 families through to its engine (controllers/mover/restic/
 mover.go:317-364). These tests pin the rebuilt routing: a real
-SharedKey client against the verifying fake Azure server, S3-compat
-rerouting for B2/GCS, and explicit (never silent) refusals for
-missing credentials and for Swift.
+SharedKey client against the verifying fake Azure server, a real
+Keystone-v3/v1 Swift client against the verifying fake Swift server,
+S3-compat rerouting for B2/GCS, and explicit (never silent) refusals
+for missing credentials.
 """
 
 import pytest
@@ -141,6 +142,117 @@ def test_gs_routes_to_interop():
             "GOOGLE_APPLICATION_CREDENTIALS": "/sa.json"})
 
 
-def test_swift_refused_with_guidance():
-    with pytest.raises(ValueError, match="swift"):
+@pytest.fixture
+def swift():
+    from volsync_tpu.objstore.fakeswift import FakeSwiftServer
+
+    with FakeSwiftServer() as srv:
+        store = open_store("swift:backups:/ns/repo", env={
+            "OS_AUTH_URL": srv.endpoint + "/v3",
+            "OS_USERNAME": srv.username,
+            "OS_PASSWORD": srv.password,
+            "OS_PROJECT_NAME": srv.project,
+            "OS_REGION_NAME": srv.region,
+        })
+        yield srv, store
+
+
+def test_swift_roundtrip(swift):
+    _, store = swift
+    store.put("config", b"hello config")
+    assert store.get("config") == b"hello config"
+    assert store.exists("config") and not store.exists("nope")
+    assert store.size("config") == len(b"hello config")
+    assert store.get_range("config", 6, 6) == b"config"
+    with pytest.raises(NoSuchKey):
+        store.get("missing")
+    with pytest.raises(NoSuchKey):
+        store.size("missing")
+    store.delete("config")
+    assert not store.exists("config")
+    store.delete("config")  # idempotent
+
+
+def test_swift_put_if_absent_and_pagination(swift):
+    srv, store = swift
+    assert store.put_if_absent("config", b"first") is True
+    assert store.put_if_absent("config", b"second") is False
+    assert store.get("config") == b"first"
+    srv.max_results = 7
+    keys = [f"data/{i:02d}/obj{i:03d}" for i in range(25)]
+    for k in keys:
+        store.put(k, b"x")
+    assert sorted(store.list("data/")) == sorted(keys)
+    assert list(store.list("data/01/")) == ["data/01/obj001"]
+
+
+def test_swift_reauth_on_expired_token(swift):
+    """Mid-run token expiry: the client re-authenticates once and
+    retries (restic's swift backend refreshes the same way)."""
+    srv, store = swift
+    store.put("k", b"v")
+    before = srv.auth_count
+    srv.revoke_tokens()
+    assert store.get("k") == b"v"  # 401 -> re-auth -> retry
+    assert srv.auth_count == before + 1
+
+
+def test_swift_v1_auth(swift):
+    srv, _ = swift
+    store = open_store("swift:backups:/v1test", env={
+        "ST_AUTH": srv.endpoint + "/auth/v1.0",
+        "ST_USER": srv.username,
+        "ST_KEY": srv.password,
+    })
+    store.put("a", b"1")
+    assert store.get("a") == b"1"
+
+
+def test_swift_rejects_bad_credentials(swift):
+    from volsync_tpu.objstore.swift import SwiftError
+
+    srv, _ = swift
+    bad = open_store("swift:backups:/p", env={
+        "OS_AUTH_URL": srv.endpoint + "/v3",
+        "OS_USERNAME": srv.username,
+        "OS_PASSWORD": "wrong",
+        "OS_PROJECT_NAME": srv.project,
+    })
+    with pytest.raises(SwiftError):
+        bad.put("k", b"v")
+
+
+def test_swift_repository_end_to_end(swift, tmp_path):
+    """The restic-equivalent repository runs unmodified over Swift —
+    the same engine the reference points at swift: URLs
+    (restic/mover.go:331-363 env passthrough)."""
+    import numpy as np
+
+    from volsync_tpu.engine import TreeBackup, restore_snapshot
+    from volsync_tpu.repo.repository import Repository
+
+    _, store = swift
+    repo = Repository.init(store, password="pw", chunker={
+        "min_size": 1024, "avg_size": 4096, "max_size": 16384, "seed": 7})
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.RandomState(3)
+    (src / "f.bin").write_bytes(rng.bytes(120_000))
+    snap, _ = TreeBackup(repo).run(src)
+    dst = tmp_path / "dst"
+    dst.mkdir()
+    restore_snapshot(repo, dst)
+    assert (dst / "f.bin").read_bytes() == (src / "f.bin").read_bytes()
+    assert repo.check(read_data=True) == []
+
+
+def test_swift_missing_credentials():
+    with pytest.raises(ValueError, match="OS_AUTH_URL"):
         open_store("swift:container:/p", env={})
+    with pytest.raises(ValueError, match="OS_PASSWORD"):
+        open_store("swift:container:/p", env={
+            "OS_AUTH_URL": "http://keystone/v3",
+            "OS_USERNAME": "u", "OS_PROJECT_NAME": "p"})
+    with pytest.raises(ValueError, match="ST_KEY"):
+        open_store("swift:container:/p", env={
+            "ST_AUTH": "http://swift/auth/v1.0", "ST_USER": "u"})

@@ -223,9 +223,8 @@ def open_store(url: str, env: Optional[dict] = None) -> ObjectStore:
       ``azure:container:/path`` (SharedKey client, objstore/azure.py),
       ``b2:bucket:/path`` (via B2's S3-compatible endpoint),
       ``gs:bucket:/path`` (via GCS's S3-interop XML API, HMAC keys),
-      ``file:///path``, ``mem:``, or a bare path.
-    ``swift:`` is refused with guidance (no Keystone client) rather
-    than silently misconfigured.
+      ``swift:container:/path`` (Keystone v3 / v1 auth,
+      objstore/swift.py), ``file:///path``, ``mem:``, or a bare path.
     """
     import os as _os
 
@@ -243,11 +242,9 @@ def open_store(url: str, env: Optional[dict] = None) -> ObjectStore:
     if url.startswith("gs:"):
         return _gs_store(url, env_map)
     if url.startswith("swift:") or url.startswith("swift-temp:"):
-        raise ValueError(
-            "swift: repositories are not supported (no Keystone auth "
-            "client); point the repository at your cluster's S3 "
-            "middleware endpoint instead (s3:https://...) — see "
-            "docs/usage/restic.md")
+        from volsync_tpu.objstore.swift import SwiftObjectStore
+
+        return SwiftObjectStore.from_url(url, env_map)
     if url.startswith("mem:"):
         return MemObjectStore()
     if url.startswith("file://"):
