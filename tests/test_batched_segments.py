@@ -35,6 +35,7 @@ def _kw(cand_cap, chunk_cap, **extra):
                 chunk_cap=chunk_cap, **extra)
 
 
+@pytest.mark.slow
 def test_batched_matches_single_lane_for_lane(rng):
     cand_cap, chunk_cap = segment_caps(SEG, P)
     lens = [SEG, SEG - 5000, 3 * 4096 + 17, SEG // 2, 0, SEG - 1]
@@ -65,6 +66,7 @@ def test_batched_matches_single_lane_for_lane(rng):
             assert d == blobid.blob_id(view[s: s + l])
 
 
+@pytest.mark.slow
 def test_batched_empty_and_all_zero_lanes():
     cand_cap, chunk_cap = segment_caps(SEG, P)
     rows = np.zeros((3, SEG), dtype=np.uint8)  # pathological: all zeros
@@ -109,6 +111,7 @@ def test_batched_duplicate_content_same_ids(rng):
 
 
 
+@pytest.mark.slow
 def test_batched_hasher_driver(rng):
     """BatchedSegmentHasher: ragged inputs through one dispatch; lanes
     agree with the single-segment driver chunk for chunk."""
@@ -135,6 +138,7 @@ def test_batched_hasher_driver(rng):
             assert d == blobid.blob_id(buf[s: s + l])
 
 
+@pytest.mark.slow
 def test_treebackup_with_shared_batcher(tmp_path, monkeypatch):
     """VOLSYNC_BATCH_SEGMENTS=1: TreeBackup's concurrent file workers
     coalesce segments through the shared microbatcher and the snapshot
@@ -199,6 +203,7 @@ def test_treebackup_with_shared_batcher(tmp_path, monkeypatch):
     assert batch_sizes and any(s > 1 for s in batch_sizes), batch_sizes
 
 
+@pytest.mark.slow
 def test_microbatcher_pipelined_concurrent_submits(rng):
     """Many concurrent producers through a pipeline_depth=2 batcher:
     every caller gets ITS lane's result (no cross-batch mixups while
@@ -243,6 +248,7 @@ def test_batching_default_follows_backend(monkeypatch):
     assert bm._batching_enabled() is False
 
 
+@pytest.mark.slow
 def test_treebackup_batched_plus_device_verified_restore(tmp_path,
                                                          monkeypatch):
     """Feature interaction guard: the shared micro-batcher (batched

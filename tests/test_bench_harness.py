@@ -90,6 +90,7 @@ def test_parse_config():
     assert bench._parse_config("B32,8,8") == ("B", 32, 8, 8)
 
 
+@pytest.mark.slow
 def test_batched_throughput_golden_path():
     """Drive _try_batched_throughput end-to-end on the CPU backend at a
     tiny shape: exercises the batched dispatch, the on-TPU-style golden
@@ -98,6 +99,7 @@ def test_batched_throughput_golden_path():
     assert out > 0
 
 
+@pytest.mark.slow
 def test_device_throughput_golden_path():
     """Same for the single-segment path (its golden warm check runs the
     full host-reference comparison)."""

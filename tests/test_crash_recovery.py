@@ -61,6 +61,7 @@ def src_tree(tmp_path):
     return src
 
 
+@pytest.mark.slow
 def test_backup_crash_then_retry_restores(tmp_path, src_tree):
     root = tmp_path / "store"
     fs = FsObjectStore(str(root))

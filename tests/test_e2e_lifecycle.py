@@ -158,6 +158,7 @@ def test_paused_cr_holds_job_until_unpaused(world, rng):
         manager.stop()
 
 
+@pytest.mark.slow
 def test_backoff_limit_recreates_job_and_recovers(world, rng):
     """A misconfigured mover fails past its backoff limit: the Job is
     deleted + recreated fresh with a TransferFailed event

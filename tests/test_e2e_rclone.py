@@ -122,6 +122,7 @@ def _sync_destination(cluster, tag, name="down"):
     return pathlib.Path(snap.status.bound_content)
 
 
+@pytest.mark.slow
 def test_bucket_mirror_roundtrip_and_delete_extraneous(world, rng):
     cluster, tmp_path = world
     files = {

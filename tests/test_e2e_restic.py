@@ -174,6 +174,7 @@ def test_misconfigured_spec_surfaces_error(world):
             c.reason == "Error" for c in cr.status.conditions)))
 
 
+@pytest.mark.slow
 def test_point_in_time_restore_selectors(world):
     """The reference's test_restic_restore_previous / restoreAsOf
     playbooks: three backups of evolving content, then destinations
@@ -260,6 +261,7 @@ def test_chunker_align_knob(tmp_path):
                        "VOLSYNC_CHUNKER_ALIGN": "512"})
 
 
+@pytest.mark.slow
 def test_cr_path_preserves_fidelity(world, rng):
     """Fidelity through the FULL operator path (CR -> mover Job ->
     engine -> restore CR): hardlinks, xattrs, sparse files, and a FIFO

@@ -57,6 +57,7 @@ def wait(cluster, pred, timeout=30.0):
     assert cluster.wait_for(pred, timeout=timeout, poll=0.05), "timed out"
 
 
+@pytest.mark.slow
 def test_rsync_push_roundtrip_and_delta(world, rng):
     cluster = world
     files = {"app.db": rng.bytes(400_000), "conf/settings.ini": b"[a]\nx=1\n"}

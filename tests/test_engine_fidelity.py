@@ -19,6 +19,7 @@ def _mkrepo():
     return Repository.init(MemObjectStore(), chunker=CHUNKER)
 
 
+@pytest.mark.slow
 def test_hardlinks_roundtrip(tmp_path, rng):
     src = tmp_path / "src"
     src.mkdir()
@@ -51,6 +52,7 @@ def test_hardlinks_roundtrip(tmp_path, rng):
     assert (dst / "b_link.bin").stat().st_ino == ino
 
 
+@pytest.mark.slow
 def test_hardlink_first_path_removed_between_backups(tmp_path, rng):
     """The secondary's parent entry must NOT feed unchanged-file dedup:
     removing the first-seen name drops nlink 2->1 WITHOUT touching the
@@ -73,6 +75,7 @@ def test_hardlink_first_path_removed_between_backups(tmp_path, rng):
     assert (dst / "b.bin").read_bytes() == payload
 
 
+@pytest.mark.slow
 def test_sparse_restore_materializes_holes(tmp_path, rng):
     src = tmp_path / "src"
     src.mkdir()
@@ -102,6 +105,7 @@ def test_sparse_restore_materializes_holes(tmp_path, rng):
     assert allocated < size // 2, (allocated, size)
 
 
+@pytest.mark.slow
 def test_sparse_disabled_writes_dense(tmp_path, rng, monkeypatch):
     src = tmp_path / "src"
     src.mkdir()

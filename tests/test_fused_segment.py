@@ -39,6 +39,7 @@ def run_engine(data: bytes, params, *, eof=True):
 
 @pytest.mark.parametrize("n", [5000, 65536, 300_000, 300_000 + 4096,
                                1_050_000])
+@pytest.mark.slow
 def test_fused_matches_host_reference_random(rng, n):
     data = rng.randint(0, 256, size=(n,), dtype=np.uint8).tobytes()
     assert run_engine(data, PARAMS) == host_reference(data, PARAMS)
@@ -50,6 +51,7 @@ def test_split_phase_matches_host_reference(rng, n):
     assert run_engine(data, PARAMS64) == host_reference(data, PARAMS64)
 
 
+@pytest.mark.slow
 def test_fused_matches_on_redundant_data(rng):
     block = rng.randint(0, 256, size=(131072,), dtype=np.uint8).tobytes()
     data = block * 4 + rng.randint(0, 256, size=(50_000,),
@@ -70,6 +72,7 @@ def test_fused_zero_entropy_forces_max_cuts():
     assert all(l <= PARAMS.max_size for _, l, _ in got)
 
 
+@pytest.mark.slow
 def test_fused_non_eof_withholds_tail(rng):
     data = rng.randint(0, 256, size=(500_000,), dtype=np.uint8).tobytes()
     ref = host_reference(data, PARAMS, eof=False)
@@ -80,6 +83,7 @@ def test_fused_non_eof_withholds_tail(rng):
     assert end % 4096 == 0      # interior cuts stay on the page grid
 
 
+@pytest.mark.slow
 def test_fused_streaming_bit_identical_to_oneshot(rng):
     data = rng.randint(0, 256, size=(2_000_000,), dtype=np.uint8).tobytes()
     pos = [0]
@@ -97,6 +101,7 @@ def test_fused_streaming_bit_identical_to_oneshot(rng):
         [(l, d) for _, l, d in host_reference(data, PARAMS)]
 
 
+@pytest.mark.slow
 def test_fused_capacity_retry(rng):
     # Dispatch with deliberately tiny capacities: the true counts in the
     # packed result must trigger host-side retry and still converge to
@@ -190,6 +195,7 @@ def test_hash_spans_overlapping_aligned_fallback(rng):
         assert d == blobid.blob_id(buf[s: s + l])
 
 
+@pytest.mark.slow
 def test_pagemajor_layout_bit_identical(rng, monkeypatch):
     """VOLSYNC_PAGEMAJOR flips the digest-table layout (contiguous
     per-page words for the root gather); the packed program result must
@@ -227,6 +233,7 @@ def test_pagemajor_layout_bit_identical(rng, monkeypatch):
     np.testing.assert_array_equal(base, flipped)
 
 
+@pytest.mark.slow
 def test_walk_table_randomized_vs_scalar_reference(rng):
     """Property test for the successor-table walk: random candidate
     sets and lengths (including L < min_size, L a page multiple, L-1

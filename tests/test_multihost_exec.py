@@ -15,9 +15,12 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 WORKER = Path(__file__).with_name("multihost_worker.py")
 
 
+@pytest.mark.slow
 def test_two_process_sharded_step():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

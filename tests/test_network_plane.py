@@ -40,6 +40,7 @@ def test_chunk_stream_matches_local(service, rng):
     assert b"".join(data[o: o + l] for o, l, _ in remote) == data
 
 
+@pytest.mark.slow
 def test_streaming_segmentation_is_invisible(service, rng):
     """Feeding the stream in awkward piece sizes must not change
     boundaries (the carry-the-tail protocol)."""
@@ -141,6 +142,7 @@ def test_rsync_across_two_processes(tmp_path, rng):
             proc.kill()
 
 
+@pytest.mark.slow
 def test_service_microbatches_concurrent_streams(rng):
     """Concurrent ChunkHash RPCs coalesce into multi-lane device
     dispatches (SegmentMicroBatcher), and every stream still chunks

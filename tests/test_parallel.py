@@ -95,6 +95,7 @@ def test_single_chip_block_matches(rng):
     )
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import __graft_entry__ as ge
 

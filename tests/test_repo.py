@@ -333,6 +333,7 @@ def test_lock_contenders_back_out_and_one_proceeds():
     assert list(repo.store.list("locks/")) == []
 
 
+@pytest.mark.slow
 def test_parallel_backup_bit_identical_and_consistent(tmp_path, rng):
     """Worker-pool hashing must produce the identical snapshot id as the
     serial path (tree assembly is order-independent), dedup concurrent

@@ -39,6 +39,7 @@ def _incompressible(i: int, size: int) -> bytes:
     return bytes(out[:size])
 
 
+@pytest.mark.slow
 def test_compact_index_million_blob_memory_bound():
     """1M synthetic blobs: the index (keys + entries + slot table) stays
     under 100 MB and under ~5us/insert — the dict it replaced costs ~500

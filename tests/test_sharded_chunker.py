@@ -22,6 +22,7 @@ def mesh_hasher():
     return MeshChunkHasher(PARAMS)
 
 
+@pytest.mark.slow
 def test_identical_to_single_chip(mesh_hasher, rng):
     buf = rng.randint(0, 256, size=(2 * 1024 * 1024 + 777,), dtype=np.uint8)
     single = DeviceChunkHasher(PARAMS).process(buf)
@@ -128,6 +129,7 @@ def test_tree_backup_snapshots_bit_identical(tmp_path, rng):
     assert (dest / "big.bin").read_bytes() == (src / "big.bin").read_bytes()
 
 
+@pytest.mark.slow
 def test_restic_mover_e2e_mesh_engine(tmp_path, rng):
     """VOLSYNC_ENGINE=mesh in the mover env routes the real backup Job
     through the sharded engine (SURVEY §7 step 5 done-condition)."""
@@ -201,6 +203,7 @@ def fused_mesh_hasher():
     return MeshChunkHasher(FUSED)
 
 
+@pytest.mark.slow
 def test_fused_mesh_identical_to_single_chip(fused_mesh_hasher, rng):
     buf = rng.randint(0, 256, size=(2 * 1024 * 1024 + 777,), dtype=np.uint8)
     single = DeviceChunkHasher(FUSED).process(buf)
@@ -215,6 +218,7 @@ def test_fused_mesh_identical_to_single_chip(fused_mesh_hasher, rng):
         assert d == blobid.blob_id(buf.tobytes()[s: s + l])
 
 
+@pytest.mark.slow
 def test_fused_mesh_without_eof(fused_mesh_hasher, rng):
     buf = rng.randint(0, 256, size=(1_500_000,), dtype=np.uint8)
     single = DeviceChunkHasher(FUSED).process(buf, eof=False)
@@ -224,6 +228,7 @@ def test_fused_mesh_without_eof(fused_mesh_hasher, rng):
     assert 0 < end < buf.shape[0] and end % 4096 == 0
 
 
+@pytest.mark.slow
 def test_fused_mesh_zero_entropy_max_cuts(fused_mesh_hasher):
     buf = np.zeros((1_000_000,), np.uint8)
     sharded = fused_mesh_hasher.process(buf)
@@ -233,6 +238,7 @@ def test_fused_mesh_zero_entropy_max_cuts(fused_mesh_hasher):
     assert len({d for _, _, d in sharded[:-1]}) == 1
 
 
+@pytest.mark.slow
 def test_fused_mesh_capacity_retry(rng):
     # chunk_cap starts far too small for the chunk count this data
     # produces; the in-band counts must drive the doubling retry.

@@ -57,20 +57,28 @@ def _read_xattrs(path) -> dict:
 
 def _load_parent_files(repo: Repository, parent_tree: str,
                        prefix: str = "") -> dict:
-    """Flatten the parent snapshot's tree into {relpath: file entry}."""
+    """Flatten the parent snapshot's tree into {relpath: file entry}.
+
+    Iterative (explicit stack): directory depth is bounded by memory,
+    not the interpreter's recursion limit — a legal-but-deep volume
+    (the reference's engines stream arbitrary depth) must not crash
+    the walk."""
     out = {}
-    tree = json.loads(repo.read_blob(parent_tree))
-    for entry in tree["entries"]:
-        path = f"{prefix}{entry['name']}"
-        if entry["type"] == "file":
-            # Hardlink-secondary entries carry no content of their own;
-            # offering them for unchanged-file dedup would match a
-            # now-unlinked file (nlink 2->1 leaves mtime untouched) and
-            # resolve it to empty content.
-            if "hardlink_to" not in entry:
-                out[path] = entry
-        elif entry["type"] == "dir":
-            out.update(_load_parent_files(repo, entry["subtree"], path + "/"))
+    stack = [(parent_tree, prefix)]
+    while stack:
+        tree_id, pfx = stack.pop()
+        tree = json.loads(repo.read_blob(tree_id))
+        for entry in tree["entries"]:
+            path = f"{pfx}{entry['name']}"
+            if entry["type"] == "file":
+                # Hardlink-secondary entries carry no content of their
+                # own; offering them for unchanged-file dedup would
+                # match a now-unlinked file (nlink 2->1 leaves mtime
+                # untouched) and resolve it to empty content.
+                if "hardlink_to" not in entry:
+                    out[path] = entry
+            elif entry["type"] == "dir":
+                stack.append((entry["subtree"], path + "/"))
     return out
 
 
@@ -192,113 +200,155 @@ class TreeBackup:
         files resolve to the parent's content list immediately. All
         stats counted here (except per-blob counts, which the
         repository updates under its own lock) so worker threads never
-        touch the shared counters."""
-        entries = []
-        for child in sorted(dirpath.iterdir(), key=lambda p: p.name):
-            st = child.lstat()
-            meta = {"name": child.name, "mode": st.st_mode & 0o7777,
-                    "mtime_ns": st.st_mtime_ns}
-            xs = _read_xattrs(child)
-            if xs:
-                # only-when-present: tree ids of xattr-less trees stay
-                # identical to pre-xattr snapshots (parent dedup keeps
-                # working across the format addition)
-                meta["xattrs"] = xs
-            # owner/group (rsync -o -g, part of the reference's -a;
-            # mover-rsync/source.sh:54). Recorded unconditionally:
-            # root:root must be restorable too (ownership drift on a
-            # root-owned file has to converge back), and restore treats
-            # an ABSENT key — a pre-format snapshot — as "unknown,
-            # leave the destination's owner alone".
-            meta["uid"] = st.st_uid
-            meta["gid"] = st.st_gid
-            if stat_mod.S_ISLNK(st.st_mode):
-                entries.append({**meta, "type": "symlink",
-                                "target": os.readlink(child)})
-            elif stat_mod.S_ISDIR(st.st_mode):
-                sub = self._walk_dir(child, f"{rel}{child.name}/",
-                                     parent_files, stats, jobs,
-                                     inode_first)
-                entries.append({**meta, "type": "dir", "skeleton": sub})
-            elif stat_mod.S_ISREG(st.st_mode):
-                frel = f"{rel}{child.name}"
-                stats.files += 1
-                # Hardlink preservation (reference: rsync -H in
-                # mover-rsync/source.sh:54): later sightings of a
-                # multiply-linked inode record a link to the FIRST
-                # sighting's path (deterministic — the walk is sorted
-                # and single-threaded) instead of re-hashing content.
-                if st.st_nlink > 1:
-                    ino = (st.st_dev, st.st_ino)
-                    first = inode_first.get(ino)
-                    if first is not None:
-                        entries.append({**meta, "type": "file",
-                                        "size": st.st_size,
-                                        "hardlink_to": first,
-                                        "content": [], "rel": frel})
-                        continue
-                    inode_first[ino] = frel
-                stats.bytes_scanned += st.st_size
-                prev = parent_files.get(frel)
-                if (prev is not None and prev["size"] == st.st_size
-                        and prev["mtime_ns"] == st.st_mtime_ns
-                        and all(self.repo.has_blob(b)
-                                for b in prev["content"])):
-                    stats.blobs_dedup += len(prev["content"])
-                    stats.bytes_dedup += st.st_size
-                    content = list(prev["content"])
-                elif st.st_size == 0:
-                    content = []
-                else:
-                    content = None  # resolved by _hash_file
-                    jobs.append((child, frel, st))
-                entries.append({**meta, "type": "file", "size": st.st_size,
-                                "content": content, "rel": frel})
-            elif stat_mod.S_ISFIFO(st.st_mode) or stat_mod.S_ISSOCK(
-                    st.st_mode) or stat_mod.S_ISBLK(st.st_mode) \
-                    or stat_mod.S_ISCHR(st.st_mode):
-                # specials (rsync -D, part of the reference's -a): FIFOs
-                # and sockets recreate from the mode; device nodes also
-                # carry st_rdev. Restore degrades gracefully without
-                # CAP_MKNOD (devices need it; FIFOs/sockets don't).
-                special = {**meta, "type": "special",
-                           "fmt": stat_mod.S_IFMT(st.st_mode)}
-                if stat_mod.S_ISBLK(st.st_mode) or stat_mod.S_ISCHR(
-                        st.st_mode):
-                    special["rdev"] = st.st_rdev
-                entries.append(special)
-        return {"entries": entries}
+        touch the shared counters.
+
+        Iterative (one child-iterator frame per open directory):
+        pushing a frame and resuming the parent's iterator afterwards
+        reproduces the recursion's exact in-order DFS — inode_first's
+        "first sighting" stays deterministic — while directory depth
+        is bounded by memory, not the interpreter recursion limit
+        (the reference's engines stream arbitrary depth)."""
+        root_skel = {"entries": []}
+
+        def children(d: Path):
+            return iter(sorted(d.iterdir(), key=lambda p: p.name))
+
+        stack = [(children(dirpath), rel, root_skel["entries"])]
+        while stack:
+            it, cur_rel, entries = stack[-1]
+            descended = False
+            for child in it:
+                st = child.lstat()
+                meta = {"name": child.name, "mode": st.st_mode & 0o7777,
+                        "mtime_ns": st.st_mtime_ns}
+                xs = _read_xattrs(child)
+                if xs:
+                    # only-when-present: tree ids of xattr-less trees
+                    # stay identical to pre-xattr snapshots (parent
+                    # dedup keeps working across the format addition)
+                    meta["xattrs"] = xs
+                # owner/group (rsync -o -g, part of the reference's -a;
+                # mover-rsync/source.sh:54). Recorded unconditionally:
+                # root:root must be restorable too (ownership drift on
+                # a root-owned file has to converge back), and restore
+                # treats an ABSENT key — a pre-format snapshot — as
+                # "unknown, leave the destination's owner alone".
+                meta["uid"] = st.st_uid
+                meta["gid"] = st.st_gid
+                if stat_mod.S_ISLNK(st.st_mode):
+                    entries.append({**meta, "type": "symlink",
+                                    "target": os.readlink(child)})
+                elif stat_mod.S_ISDIR(st.st_mode):
+                    sub = {"entries": []}
+                    entries.append({**meta, "type": "dir",
+                                    "skeleton": sub})
+                    stack.append((children(child),
+                                  f"{cur_rel}{child.name}/",
+                                  sub["entries"]))
+                    descended = True
+                    break
+                elif stat_mod.S_ISREG(st.st_mode):
+                    self._walk_file(child, f"{cur_rel}{child.name}",
+                                    st, meta, entries, parent_files,
+                                    stats, jobs, inode_first)
+                elif stat_mod.S_ISFIFO(st.st_mode) or stat_mod.S_ISSOCK(
+                        st.st_mode) or stat_mod.S_ISBLK(st.st_mode) \
+                        or stat_mod.S_ISCHR(st.st_mode):
+                    # specials (rsync -D, part of the reference's -a):
+                    # FIFOs and sockets recreate from the mode; device
+                    # nodes also carry st_rdev. Restore degrades
+                    # gracefully without CAP_MKNOD (devices need it;
+                    # FIFOs/sockets don't).
+                    special = {**meta, "type": "special",
+                               "fmt": stat_mod.S_IFMT(st.st_mode)}
+                    if stat_mod.S_ISBLK(st.st_mode) or stat_mod.S_ISCHR(
+                            st.st_mode):
+                        special["rdev"] = st.st_rdev
+                    entries.append(special)
+            if not descended:
+                stack.pop()
+        return root_skel
+
+    def _walk_file(self, child: Path, frel: str, st, meta: dict,
+                   entries: list, parent_files: dict, stats: BackupStats,
+                   jobs: list, inode_first: dict) -> None:
+        """Regular-file walk step (shared by every _walk_dir frame)."""
+        stats.files += 1
+        # Hardlink preservation (reference: rsync -H in
+        # mover-rsync/source.sh:54): later sightings of a
+        # multiply-linked inode record a link to the FIRST sighting's
+        # path (deterministic — the walk is sorted and
+        # single-threaded) instead of re-hashing content.
+        if st.st_nlink > 1:
+            ino = (st.st_dev, st.st_ino)
+            first = inode_first.get(ino)
+            if first is not None:
+                entries.append({**meta, "type": "file",
+                                "size": st.st_size,
+                                "hardlink_to": first,
+                                "content": [], "rel": frel})
+                return
+            inode_first[ino] = frel
+        stats.bytes_scanned += st.st_size
+        prev = parent_files.get(frel)
+        if (prev is not None and prev["size"] == st.st_size
+                and prev["mtime_ns"] == st.st_mtime_ns
+                and all(self.repo.has_blob(b)
+                        for b in prev["content"])):
+            stats.blobs_dedup += len(prev["content"])
+            stats.bytes_dedup += st.st_size
+            content = list(prev["content"])
+        elif st.st_size == 0:
+            content = []
+        else:
+            content = None  # resolved by _hash_file
+            jobs.append((child, frel, st))
+        entries.append({**meta, "type": "file", "size": st.st_size,
+                        "content": content, "rel": frel})
 
     def _assemble_tree(self, skeleton: dict, contents: dict,
                        stats: BackupStats) -> str:
         """Deterministic bottom-up tree-blob construction from the walk
         skeleton + hashed file contents (independent of hashing order,
-        so snapshots are bit-identical for any worker count)."""
-        entries = []
-        for e in skeleton["entries"]:
-            if e.get("skeleton") is not None:
-                sub = self._assemble_tree(e["skeleton"], contents, stats)
-                e = {k: v for k, v in e.items() if k != "skeleton"}
-                e["subtree"] = sub
-            elif e.get("type") == "file":
-                e = dict(e)
-                rel = e.pop("rel")
-                if e["content"] is None:
-                    content, size, mtime_ns = contents[rel]
-                    # Metadata observed AT read time, not walk time: a
-                    # file rewritten between the walk's lstat and the
-                    # worker's read must not pair new content with
-                    # stale size/mtime (restore's unchanged-skip
-                    # heuristic keys on them).
-                    e["content"] = content
-                    e["size"] = size
-                    e["mtime_ns"] = mtime_ns
-            entries.append(e)
-        tree_json = json.dumps({"entries": entries},
-                               sort_keys=True).encode()
-        tid = _tree_id(tree_json)
-        self.repo.add_blob(BLOB_TREE, tid, tree_json, stats)
-        return tid
+        so snapshots are bit-identical for any worker count). Iterative
+        post-order — children's tree blobs are written before the
+        parent serializes references to them, at any depth."""
+        done: dict = {}  # id(skeleton node) -> tree id
+        stack = [(skeleton, False)]
+        while stack:
+            node, ready = stack.pop()
+            if not ready:
+                stack.append((node, True))
+                for e in node["entries"]:
+                    if e.get("skeleton") is not None:
+                        stack.append((e["skeleton"], False))
+                continue
+            entries = []
+            for e in node["entries"]:
+                if e.get("skeleton") is not None:
+                    sub = done.pop(id(e["skeleton"]))
+                    e = {k: v for k, v in e.items() if k != "skeleton"}
+                    e["subtree"] = sub
+                elif e.get("type") == "file":
+                    e = dict(e)
+                    rel = e.pop("rel")
+                    if e["content"] is None:
+                        content, size, mtime_ns = contents[rel]
+                        # Metadata observed AT read time, not walk
+                        # time: a file rewritten between the walk's
+                        # lstat and the worker's read must not pair new
+                        # content with stale size/mtime (restore's
+                        # unchanged-skip heuristic keys on them).
+                        e["content"] = content
+                        e["size"] = size
+                        e["mtime_ns"] = mtime_ns
+                entries.append(e)
+            tree_json = json.dumps({"entries": entries},
+                                   sort_keys=True).encode()
+            tid = _tree_id(tree_json)
+            self.repo.add_blob(BLOB_TREE, tid, tree_json, stats)
+            done[id(node)] = tid
+        return done[id(skeleton)]
 
     def _hash_file(self, path: Path, rel: str, st,
                    stats: BackupStats) -> tuple[str, tuple]:

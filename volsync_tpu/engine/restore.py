@@ -102,37 +102,51 @@ class TreeRestore:
     def _walk_tree(self, tree_id: str, dirpath: Path, stats: dict,
                    jobs: list, dirs: list, links: list, *,
                    delete_extra: bool):
-        tree = json.loads(self.repo.read_blob(tree_id))
-        wanted = {e["name"] for e in tree["entries"]}
-        if delete_extra:
-            for child in dirpath.iterdir():
-                if child.name not in wanted:
-                    _rmtree(child)
-                    stats["deleted"] += 1
-        for entry in tree["entries"]:
-            target = dirpath / entry["name"]
-            if entry["type"] == "dir":
-                if target.is_symlink() or (target.exists() and not target.is_dir()):
-                    target.unlink()
-                target.mkdir(exist_ok=True)
-                dirs.append((target, entry))
-                self._walk_tree(entry["subtree"], target, stats, jobs,
-                                dirs, links, delete_extra=delete_extra)
-            elif entry["type"] == "symlink":
-                if target.is_symlink() or target.exists():
-                    _rmtree(target)
-                os.symlink(entry["target"], target)
-                _apply_owner(target, entry)
-                _apply_xattrs(target, entry)
-                os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]),
-                         follow_symlinks=False)
-            elif entry["type"] == "special":
-                self._restore_special(entry, target, stats)
-            elif entry["type"] == "file":
-                if entry.get("hardlink_to"):
-                    links.append((entry, target))
-                else:
-                    jobs.append((entry, target))
+        """Iterative DFS (explicit stack): depth bounded by memory,
+        not the interpreter recursion limit. The one ordering invariant
+        — ``dirs`` holds a parent BEFORE every descendant, so the
+        caller's reversed() metadata pass runs children-first — holds
+        because a directory is appended when first visited and its
+        subtree is pushed afterwards."""
+        stack = [(tree_id, dirpath)]
+        while stack:
+            cur_id, cur_dir = stack.pop()
+            tree = json.loads(self.repo.read_blob(cur_id))
+            wanted = {e["name"] for e in tree["entries"]}
+            if delete_extra:
+                for child in cur_dir.iterdir():
+                    if child.name not in wanted:
+                        _rmtree(child)
+                        stats["deleted"] += 1
+            subdirs = []
+            for entry in tree["entries"]:
+                target = cur_dir / entry["name"]
+                if entry["type"] == "dir":
+                    if target.is_symlink() or (target.exists()
+                                               and not target.is_dir()):
+                        target.unlink()
+                    target.mkdir(exist_ok=True)
+                    dirs.append((target, entry))
+                    subdirs.append((entry["subtree"], target))
+                elif entry["type"] == "symlink":
+                    if target.is_symlink() or target.exists():
+                        _rmtree(target)
+                    os.symlink(entry["target"], target)
+                    _apply_owner(target, entry)
+                    _apply_xattrs(target, entry)
+                    os.utime(target,
+                             ns=(entry["mtime_ns"], entry["mtime_ns"]),
+                             follow_symlinks=False)
+                elif entry["type"] == "special":
+                    self._restore_special(entry, target, stats)
+                elif entry["type"] == "file":
+                    if entry.get("hardlink_to"):
+                        links.append((entry, target))
+                    else:
+                        jobs.append((entry, target))
+            # reversed: the LIFO pop then visits subtrees in entry
+            # order, matching the recursive walk
+            stack.extend(reversed(subdirs))
 
     def _restore_special(self, entry: dict, target: Path, stats: dict):
         """FIFOs/sockets/device nodes (rsync -D analogue). Device nodes
