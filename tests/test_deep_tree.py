@@ -65,6 +65,17 @@ def test_deep_tree_backup_incremental_restore(tmp_path):
     out = dest / Path(*(["d"] * DEPTH)) / "leaf.bin"
     assert out.read_bytes() == leaf.read_bytes()
 
+    # delete_extra over a deep EXTRANEOUS tree: _rmtree must remove
+    # ~1950 levels without RecursionError (this interpreter's
+    # shutil.rmtree walks iteratively; this pins that a regression or
+    # different runtime surfaces here, not in a customer restore).
+    extra = dest / "extra"
+    extra.mkdir()
+    _build_deep(extra)
+    stats = restore_snapshot(repo, dest)
+    assert stats["deleted"] == 1
+    assert not extra.exists()
+
 
 @pytest.mark.slow
 def test_deep_tree_rclone_scan(tmp_path):
