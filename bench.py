@@ -71,8 +71,11 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 # CPU fallback subprocess (CPU_MEASURE_TIMEOUT_S) must fit inside
 # GLOBAL_BUDGET_S, or the watchdog would kill a still-progressing run
 # with no JSON emitted — the exact failure this file exists to prevent.
-# Each subprocess's own ladder (configs x per-config deadline) must fit
-# inside its timeout.
+# The recovery phase (_recover_backend) self-limits against
+# _budget_left() with a CPU-fallback reserve, and the device
+# measurement's timeout shrinks to what recovery left over, so the
+# invariant survives any recovery spend. Each subprocess's own ladder
+# (configs x per-config deadline) must fit inside its timeout.
 PROBE_TIMEOUTS = (120, 200)
 PROBE_BACKOFF_S = 15
 CONFIG_DEADLINE_S = int(os.environ.get("VOLSYNC_BENCH_CONFIG_DEADLINE", "420"))
@@ -147,14 +150,14 @@ def _force_cpu_backend():
     jax.config.update("jax_platforms", "cpu")
 
 
-def _probe_backend() -> Optional[str]:
+def _probe_backend(timeouts=PROBE_TIMEOUTS) -> Optional[str]:
     """Probe backend init in a subprocess with a hard timeout; returns
     the default backend's platform name, or None if unreachable.
 
     A wedged ``jax.devices()`` (observed: >25 min inside backend setup in
     round 3) hangs in C++ where SIGALRM cannot reliably interrupt, so the
     probe must be a separate killable process."""
-    for i, tmo in enumerate(PROBE_TIMEOUTS):
+    for i, tmo in enumerate(timeouts):
         t0 = time.perf_counter()
         try:
             r = subprocess.run(
@@ -170,8 +173,82 @@ def _probe_backend() -> Optional[str]:
                  f"{dt:.1f}s: {(r.stderr or '').strip()[-300:]}")
         except subprocess.TimeoutExpired:
             _log(f"bench: probe attempt {i + 1} timed out after {tmo}s")
-        if i + 1 < len(PROBE_TIMEOUTS):
+        if i + 1 < len(timeouts):
             time.sleep(PROBE_BACKOFF_S)
+    return None
+
+
+def _kill_stale_bench_children(
+        marker: str = "VOLSYNC_BENCH_INNER=1") -> int:
+    """SIGKILL measurement processes leaked by PRIOR bench runs — the
+    round-4 wedge cause was a leaked single-tenant session still holding
+    the serving tunnel at bench time. Targeted: only processes whose
+    environment carries ``marker`` (VOLSYNC_BENCH_INNER=1, set
+    exclusively by this harness's measurement children — a concurrent
+    second bench would itself be a single-tenant violation) and that
+    are not this process or its parent. Never touches other TPU
+    clients. ``marker`` is parameterized so tests can sweep a sentinel
+    value without ever matching a real run."""
+    import glob
+
+    killed = 0
+    own = {os.getpid(), os.getppid()}
+    want = marker.encode()
+    for path in glob.glob("/proc/[0-9]*/environ"):
+        try:
+            pid = int(path.split("/")[2])
+        except ValueError:
+            continue
+        if pid in own:
+            continue
+        try:
+            with open(path, "rb") as f:
+                env_blob = f.read()
+        except OSError:
+            continue
+        if want in env_blob.split(b"\0"):
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed += 1
+                _log(f"bench: recovery killed stale measurement pid {pid}")
+            except OSError:
+                pass
+    return killed
+
+
+def _recover_backend() -> Optional[str]:
+    """Chip-recovery phase (the committed playbook, in-process): after
+    the normal probes fail, (1) SIGKILL stale measurement children a
+    previous bench leaked on the single-tenant tunnel, (2) go QUIET and
+    re-probe sparsely over a longer horizon — killed probes each leave
+    another dead queued session needing server-side GC, so hammering
+    the tunnel extends the wedge (round-3/4 postmortems,
+    docs/performance.md). Budget-aware: always leaves room for the CPU
+    fallback + its labeling, so a never-recovering tunnel still emits
+    an honest JSON line."""
+    killed = _kill_stale_bench_children()
+    reserve = CPU_MEASURE_TIMEOUT_S + 180  # fallback + parent overhead
+    if killed:
+        # Give the server a moment to GC the killed sessions, then one
+        # immediate probe: this is the one recovery path with a known
+        # cause-and-effect.
+        time.sleep(30)
+        name = _probe_backend(timeouts=(120,))
+        if name is not None:
+            return name
+    quiet_s = int(os.environ.get("VOLSYNC_BENCH_RECOVERY_QUIET", "600"))
+    max_probes = int(os.environ.get("VOLSYNC_BENCH_RECOVERY_PROBES", "2"))
+    for i in range(max_probes):
+        wait = min(quiet_s, _budget_left() - reserve - 140)
+        if wait <= 60:
+            _log("bench: recovery window exhausted — falling back")
+            break
+        _log(f"bench: tunnel wedged — quiet {wait:.0f}s before recovery "
+             f"probe {i + 1}/{max_probes}")
+        time.sleep(wait)
+        name = _probe_backend(timeouts=(120,))
+        if name is not None:
+            return name
     return None
 
 
@@ -687,13 +764,25 @@ def main():
 
     if not os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
         probed = _probe_backend()
+        if probed is None:
+            probed = _recover_backend()
         if probed is not None and probed != "cpu":
-            out = _run_measurement_child({}, MEASURE_TIMEOUT_S)
-            if out is not None:
-                _emit(out)
-                return 0
-            _log("bench: device measurement failed — CPU-backend "
-                 "fallback")
+            # Recovery may have spent real budget: the measurement
+            # child gets what remains minus the CPU-fallback reserve,
+            # so a late recovery still lands SOME accelerator number.
+            measure_s = int(min(MEASURE_TIMEOUT_S,
+                                _budget_left() - CPU_MEASURE_TIMEOUT_S
+                                - 120))
+            if measure_s >= 300:
+                out = _run_measurement_child({}, measure_s)
+                if out is not None:
+                    _emit(out)
+                    return 0
+                _log("bench: device measurement failed — CPU-backend "
+                     "fallback")
+            else:
+                _log(f"bench: only {measure_s}s left for a device "
+                     f"measurement — CPU-backend fallback")
         else:
             _log(f"bench: accelerator unavailable (probe={probed}) — "
                  f"CPU-backend fallback")

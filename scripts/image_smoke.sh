@@ -51,7 +51,10 @@ done
                   "$RUNTIME" logs "$CID" >&2 || true; exit 1; }
 
 curl -fsS "http://$ADDR/readyz" >/dev/null
-curl -fsS "http://$ADDR/metrics" | grep -q "volsync_" \
+# grep WITHOUT -q: early-exit would EPIPE curl and pipefail would turn
+# a successful match into a spurious failure once /metrics outgrows
+# the pipe buffer.
+curl -fsS "http://$ADDR/metrics" | grep "volsync_" >/dev/null \
     || { echo "image_smoke: /metrics missing volsync_ series" >&2
          exit 1; }
 

@@ -105,3 +105,48 @@ def test_device_throughput_golden_path():
     full host-reference comparison)."""
     out = bench._try_device_throughput(2, 1, 1)
     assert out > 0
+
+
+def test_recovery_kills_only_stale_inner_children():
+    """The recovery phase SIGKILLs exactly the processes carrying the
+    leaked-measurement environment marker — the round-4 wedge cause —
+    and nothing else. Uses a per-test sentinel marker so the sweep can
+    never touch a real bench running elsewhere on the host."""
+    import os
+    import subprocess
+    import sys
+
+    sentinel = f"VOLSYNC_BENCH_TEST_{os.getpid()}"
+    stale = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+        env={**os.environ, sentinel.split("=")[0]: "1",
+             "VOLSYNC_BENCH_SENTINEL": sentinel})
+    bystander = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+        env=dict(os.environ))
+    try:
+        time.sleep(0.3)
+        killed = bench._kill_stale_bench_children(
+            marker=f"VOLSYNC_BENCH_SENTINEL={sentinel}")
+        assert killed == 1
+        assert stale.wait(timeout=10) == -signal.SIGKILL
+        assert bystander.poll() is None  # untouched
+    finally:
+        for p in (stale, bystander):
+            if p.poll() is None:
+                p.kill()
+
+
+def test_recovery_respects_cpu_fallback_reserve(monkeypatch):
+    """With the budget nearly spent, the recovery phase must not sleep
+    into the CPU-fallback reserve — it gives up quickly so the labeled
+    fallback still has room to emit a JSON line."""
+    monkeypatch.setattr(bench, "_kill_stale_bench_children", lambda: 0)
+    monkeypatch.setattr(bench, "_budget_left",
+                        lambda: bench.CPU_MEASURE_TIMEOUT_S + 200)
+    calls = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: calls.append(s))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeouts=None: None)
+    assert bench._recover_backend() is None
+    assert calls == []  # no quiet-wait: window already exhausted

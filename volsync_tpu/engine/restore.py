@@ -346,10 +346,33 @@ def _write_sparse(f, data) -> None:
 
 
 def _rmtree(path: Path):
-    import shutil
-
+    """Depth-safe recursive delete. Explicit stack rather than
+    shutil.rmtree: the walkers' any-depth guarantee must hold for
+    delete_extra too, on every supported interpreter (shutil.rmtree
+    recurses per directory level before CPython 3.12)."""
     if path.is_dir() and not path.is_symlink():
-        shutil.rmtree(path, ignore_errors=True)
+        stack = [(path, False)]
+        while stack:
+            d, emptied = stack.pop()
+            if emptied:
+                try:
+                    d.rmdir()
+                except OSError:
+                    pass
+                continue
+            stack.append((d, True))
+            try:
+                entries = list(os.scandir(d))
+            except OSError:
+                continue
+            for e in entries:
+                try:
+                    if e.is_dir(follow_symlinks=False):
+                        stack.append((Path(e.path), False))
+                    else:
+                        os.unlink(e.path)
+                except OSError:
+                    pass  # best-effort, like rmtree(ignore_errors=True)
     else:
         # symlinks, regular files, AND specials (FIFO/socket/device —
         # is_file() is False for those; rmtree would leave them behind)
