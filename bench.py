@@ -228,10 +228,12 @@ def _recover_backend() -> Optional[str]:
     an honest JSON line."""
     killed = _kill_stale_bench_children()
     reserve = CPU_MEASURE_TIMEOUT_S + 180  # fallback + parent overhead
-    if killed:
+    if killed and _budget_left() - reserve > 160:
         # Give the server a moment to GC the killed sessions, then one
         # immediate probe: this is the one recovery path with a known
-        # cause-and-effect.
+        # cause-and-effect. Guarded by the same reserve as the quiet
+        # loop — a tiny operator-set budget must still reach the
+        # labeled CPU fallback.
         time.sleep(30)
         name = _probe_backend(timeouts=(120,))
         if name is not None:

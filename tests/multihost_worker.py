@@ -68,5 +68,46 @@ def main() -> int:
     return 0
 
 
+def product_main(volume: str) -> int:
+    """PRODUCT path across the process boundary: the real TreeBackup
+    with a MeshChunkHasher whose mesh spans BOTH processes — every
+    chunk boundary and blob id is computed by cross-process
+    collectives. Process 0 writes a real on-disk repository (the
+    parent restores from it); process 1's writes go to a throwaway
+    in-memory store. Both print their snapshot's TREE id: content
+    identity (the snapshot envelope itself carries wall time + a
+    sealing nonce by design, like restic's)."""
+    import os
+    from pathlib import Path
+
+    from volsync_tpu.engine import TreeBackup
+    from volsync_tpu.engine.chunker import params_from_config
+    from volsync_tpu.objstore.store import FsObjectStore, MemObjectStore
+    from volsync_tpu.parallel.sharded_chunker import (
+        MeshChunkHasher,
+        make_stream_mesh,
+    )
+    from volsync_tpu.repo.repository import Repository
+
+    info = init_distributed()
+    assert info["process_count"] == 2, info
+    pid = info["process_index"]
+    store = (FsObjectStore(os.environ["VOLSYNC_REPO_OUT"]) if pid == 0
+             else MemObjectStore())
+    repo = Repository.init(store)
+    mesh = make_stream_mesh(jax.devices())  # global: spans both procs
+    hasher = MeshChunkHasher(params_from_config(repo.chunker_params),
+                             mesh=mesh)
+    snap, stats = TreeBackup(repo, hasher=hasher).run(Path(volume))
+    assert snap is not None
+    tree = repo.list_snapshots()[-1][1]["tree"]
+    print(f"MULTIHOST-TREEBACKUP-OK p{pid} tree={tree} "
+          f"files={stats.files} bytes={stats.bytes_scanned} "
+          f"mesh={mesh.devices.size}", flush=True)
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "treebackup":
+        sys.exit(product_main(sys.argv[2]))
     sys.exit(main())

@@ -20,12 +20,20 @@ import pytest
 WORKER = Path(__file__).with_name("multihost_worker.py")
 
 
-@pytest.mark.slow
-def test_two_process_sharded_step():
+def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
+    return port
+
+
+def _run_pair(argv_tail, extra_env=None, timeout=300):
+    """Launch the worker in both process slots of one 2-process mesh
+    and return [(rc, stdout, stderr)] — the shared scaffolding for
+    every cross-process test (coordinator port, env triplet, hang
+    kill)."""
+    port = _free_port()
 
     def env_for(pid: int) -> dict:
         env = dict(os.environ)
@@ -36,26 +44,86 @@ def test_two_process_sharded_step():
         env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["JAX_NUM_PROCESSES"] = "2"
         env["JAX_PROCESS_ID"] = str(pid)
+        env.update(extra_env or {})
         # the parent test session pins cpu via jax.config; children pin
         # their own (conftest's env alone is beaten by sitecustomize)
         return env
 
-    procs = [subprocess.Popen([sys.executable, str(WORKER)],
-                              env=env_for(i), stdout=subprocess.PIPE,
-                              stderr=subprocess.PIPE, text=True)
-             for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, str(WORKER), *argv_tail],
+        env=env_for(i), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for i in range(2)]
     results = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             out, err = p.communicate()
             raise AssertionError(f"multihost worker hung:\n{err[-800:]}")
         results.append((p.returncode, out, err))
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_sharded_step():
+    results = _run_pair([])
     for i, (rc, out, err) in enumerate(results):
         assert rc == 0, f"worker {i} rc={rc}\n{err[-1200:]}"
         assert f"MULTIHOST-OK p{i}" in out, out
     # both processes saw the same global mesh and verified digests
     assert "verified=" in results[0][1] and "verified=" in results[1][1]
+
+
+@pytest.mark.slow
+def test_two_process_treebackup_bit_identity(tmp_path):
+    """The PRODUCT backup path across a real process boundary: two
+    interpreters run TreeBackup with one global (seq) mesh — chunk
+    boundaries and blob ids come out of cross-process collectives —
+    and the resulting snapshot's TREE id must be bit-identical between
+    the two processes AND to a plain single-process DeviceChunkHasher
+    backup of the same volume. The 2-process-written repository then
+    restores byte-identical content in this (third) process."""
+    import numpy as np
+
+    from volsync_tpu.engine import TreeBackup, restore_snapshot
+    from volsync_tpu.objstore.store import FsObjectStore
+    from volsync_tpu.repo.repository import Repository
+
+    vol = tmp_path / "vol"
+    (vol / "sub").mkdir(parents=True)
+    rng = np.random.RandomState(11)
+    half = rng.bytes(1_500_000)
+    (vol / "a.bin").write_bytes(half)
+    (vol / "sub" / "b.bin").write_bytes(half)  # dedup must see this
+    (vol / "small.txt").write_bytes(b"tiny")
+
+    # Single-process reference (DeviceChunkHasher): the content truth.
+    repo_ref = Repository.init(FsObjectStore(tmp_path / "repo_ref"))
+    snap_ref, _ = TreeBackup(repo_ref).run(vol)
+    assert snap_ref is not None
+    tree_ref = repo_ref.list_snapshots()[-1][1]["tree"]
+
+    repo_out = tmp_path / "repo_2proc"
+    results = _run_pair(["treebackup", str(vol)],
+                        extra_env={"VOLSYNC_REPO_OUT": str(repo_out)})
+    trees = []
+    for i, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"worker {i} rc={rc}\n{err[-1500:]}"
+        line = next(ln for ln in out.splitlines()
+                    if "MULTIHOST-TREEBACKUP-OK" in ln)
+        trees.append(dict(kv.split("=", 1) for kv in line.split()
+                          if "=" in kv)["tree"])
+    # bit-identity: both processes, and vs the single-process engine
+    assert trees[0] == trees[1] == tree_ref
+
+    # the repository the 2-process run wrote restores byte-identically
+    repo2 = Repository.open(FsObjectStore(repo_out))
+    assert repo2.check(read_data=True) == []
+    dest = tmp_path / "restored"
+    dest.mkdir()
+    restore_snapshot(repo2, dest)
+    assert (dest / "a.bin").read_bytes() == half
+    assert (dest / "sub" / "b.bin").read_bytes() == half
+    assert (dest / "small.txt").read_bytes() == b"tiny"
