@@ -1,0 +1,135 @@
+"""mover-jax service concurrency benchmark (BASELINE configs[5] at the
+RPC layer): N concurrent ChunkHash client streams coalesce through the
+service's SegmentMicroBatcher into multi-lane device dispatches, and
+the aggregate GiB/s over the FULL service path (gRPC transport +
+streaming segmentation + batched device dispatch + result decode) is
+reported as ONE JSON line.
+
+This is the hardware form of tests/test_network_plane.py::
+test_service_microbatches_concurrent_streams — correctness is pinned
+there; this script measures. Run it ALONE on the single-tenant tunnel.
+
+Env knobs:
+  VOLSYNC_SVCBENCH_CLIENTS   concurrent streams        (default 8)
+  VOLSYNC_SVCBENCH_MIB       MiB per stream            (default 64)
+  VOLSYNC_SVCBENCH_SEG_KIB   service segment KiB       (default 4096)
+  VOLSYNC_SVCBENCH_WINDOW_MS batcher window            (default 2)
+  VOLSYNC_SVCBENCH_CPU       1 = force the CPU backend (labeled)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    clients = int(os.environ.get("VOLSYNC_SVCBENCH_CLIENTS", "8"))
+    mib = int(os.environ.get("VOLSYNC_SVCBENCH_MIB", "64"))
+    seg_kib = int(os.environ.get("VOLSYNC_SVCBENCH_SEG_KIB", "4096"))
+    window_ms = float(os.environ.get("VOLSYNC_SVCBENCH_WINDOW_MS", "2"))
+    if os.environ.get("VOLSYNC_SVCBENCH_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    # (no VOLSYNC_BATCH_SEGMENTS needed: the server builds its own
+    # microbatcher from batch_window_ms, bypassing the shared gate)
+
+    import jax
+
+    from volsync_tpu.ops.gearcdc import GearParams
+    from volsync_tpu.repo import blobid
+    from volsync_tpu.service import MoverJaxClient, MoverJaxServer
+
+    params = GearParams(min_size=64 * 1024, avg_size=1024 * 1024,
+                        max_size=4 * 1024 * 1024, align=4096)
+    n = mib * 1024 * 1024
+    base = np.random.RandomState(7).randint(0, 256, size=(n,),
+                                            dtype=np.uint8)
+    # Per-client salted payloads: the serving tunnel memoizes identical
+    # executions, so every stream must hash distinct content.
+    payloads = [(base ^ np.uint8(i + 1)).tobytes()
+                for i in range(clients)]
+
+    piece = 1024 * 1024  # stream in 1 MiB pieces (gRPC 4 MiB msg cap)
+
+    def reader_for(buf: bytes):
+        pos = [0]
+
+        def read(nbytes: int) -> bytes:
+            p = buf[pos[0]: pos[0] + min(nbytes, piece)]
+            pos[0] += len(p)
+            return p
+
+        return read
+
+    assert clients < 127, "salt space"
+    # Warm payloads carry DISJOINT salts (128+i) from the timed ones
+    # (i+1): the serving tunnel memoizes identical executions, so a
+    # warm/timed collision would replay for free and inflate the
+    # number (same invariant as bench.py's salted warm run).
+    warm_payloads = [(base ^ np.uint8(128 + i)).tobytes()
+                     for i in range(clients)]
+
+    counts = [0] * clients
+    errors: list = []
+
+    def run_one(srv, idx: int, bufs: list):
+        try:
+            with MoverJaxClient("127.0.0.1", srv.port, srv.token) as c:
+                out = list(c.chunk_stream(reader_for(bufs[idx])))
+            counts[idx] = len(out)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"client {idx}: {e}")
+
+    def run_all(srv, bufs: list):
+        threads = [threading.Thread(target=run_one, args=(srv, i, bufs))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    with MoverJaxServer(params=params, segment_size=seg_kib * 1024,
+                        batch_window_ms=window_ms) as srv:
+        # Golden: one stream checked against hashlib before timing.
+        with MoverJaxClient("127.0.0.1", srv.port, srv.token) as cl:
+            g = list(cl.chunk_stream(reader_for(warm_payloads[0])))
+        s0, l0, d0 = g[0]
+        assert d0 == blobid.blob_id(warm_payloads[0][s0:s0 + l0]), \
+            "service golden check failed"
+        # Warm at FULL concurrency so every pow2 lane-count kernel the
+        # timed phase can hit (batch lanes pad to pow2) is compiled
+        # before the clock starts.
+        run_all(srv, warm_payloads)
+        assert not errors, errors
+        counts = [0] * clients
+        dt = run_all(srv, payloads)
+    assert not errors, errors
+    assert all(c > 0 for c in counts)
+    gib = clients * n / dt / (1 << 30)
+    print(json.dumps({
+        "metric": "service_concurrent_chunkhash",
+        "value": round(gib, 3),
+        "unit": "GiB/s",
+        "clients": clients,
+        "mib_per_client": mib,
+        "segment_kib": seg_kib,
+        "backend": jax.default_backend(),
+        "chunks": sum(counts),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
