@@ -32,7 +32,8 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_RUNGS = [
     "B:64,8,6",                       # primary batched shape (r4 rung 1)
-    "B:128,8,3",                      # 2x bytes per dispatch
+    "B:128,8,3",                      # 2x bytes per dispatch (segment)
+    "B:64,16,3",                      # 2x bytes per dispatch (lanes)
     "VOLSYNC_PAGEMAJOR=1:B:64,8,6",   # page-major digest-table A/B
     "S:64,8,6",                       # per-stream fused shape, same size
 ]
