@@ -602,6 +602,9 @@ def _run_config_ladder() -> tuple[float, str]:
                 break
             if up_streams * (up_iters + 1) >= 255:
                 continue  # salt space
+            if (up_kind == "B"
+                    and up_seg * (1 << 20) * up_streams >= 1 << 31):
+                continue  # int32 gather index space (2 GiB batch cap)
             try:
                 _log(f"bench: upsize probe {up_kind}{up_seg}x{up_streams}"
                      f"x{up_iters}")

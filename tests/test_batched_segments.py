@@ -287,3 +287,40 @@ def test_treebackup_batched_plus_device_verified_restore(tmp_path,
             == (src / f"f{i}.bin").read_bytes()
     assert (dst / "holes.bin").read_bytes() \
         == (src / "holes.bin").read_bytes()
+
+
+def test_batched_rejects_over_int32_index_space():
+    """A >=2 GiB batch cannot be gathered with int32 indices (x64 off;
+    TPUs index in int32) — the library refuses loudly instead of
+    overflowing inside the tail-digest gather. Shape-only: lowering
+    with abstract avals, no 2 GiB allocation."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS as p
+    from volsync_tpu.ops.segment import chunk_hash_segments, segment_caps
+
+    n = 64 * (1 << 20)
+    cand_cap, chunk_cap = segment_caps(n, p)
+
+    @functools.partial(jax.jit, static_argnames=("cand_cap", "chunk_cap"))
+    def f(rows, vl, eof, *, cand_cap, chunk_cap):
+        return chunk_hash_segments(
+            rows, vl, eof, min_size=p.min_size, avg_size=p.avg_size,
+            max_size=p.max_size, seed=p.seed, mask_s=p.mask_s,
+            mask_l=p.mask_l, align=p.align, cand_cap=cand_cap,
+            chunk_cap=chunk_cap)
+
+    with pytest.raises(ValueError, match="int32 index space"):
+        f.lower(jax.ShapeDtypeStruct((32, n), jnp.uint8),
+                jax.ShapeDtypeStruct((32,), jnp.int32),
+                jax.ShapeDtypeStruct((32,), jnp.bool_),
+                cand_cap=cand_cap, chunk_cap=chunk_cap)
+    # 16 lanes x 64 MiB = 1 GiB stays inside and lowers fine.
+    f.lower(jax.ShapeDtypeStruct((16, n), jnp.uint8),
+            jax.ShapeDtypeStruct((16,), jnp.int32),
+            jax.ShapeDtypeStruct((16,), jnp.bool_),
+            cand_cap=cand_cap, chunk_cap=chunk_cap)

@@ -549,6 +549,14 @@ def chunk_hash_segments(data: jax.Array, valid_len: jax.Array,
     """
     assert align == LEAF_SIZE, "fused path requires page-aligned cuts"
     S, P = data.shape
+    if S * P >= 1 << 31:
+        # The flat [S*P] view is gathered with int32 indices (x64 is
+        # off; TPUs index in int32) — a >=2 GiB batch silently can't.
+        # Callers split batches instead; the bench ladder respects the
+        # same bound.
+        raise ValueError(
+            f"batched dispatch of {S}x{P} bytes exceeds the int32 "
+            f"index space (2 GiB); split the batch")
     R = P // align
     F = P // LEAF_SIZE
     npp = _n_pages_pad(S * F)
