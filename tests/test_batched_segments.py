@@ -324,3 +324,32 @@ def test_batched_rejects_over_int32_index_space():
             jax.ShapeDtypeStruct((16,), jnp.int32),
             jax.ShapeDtypeStruct((16,), jnp.bool_),
             cand_cap=cand_cap, chunk_cap=chunk_cap)
+
+
+def test_hash_bucket_splits_at_index_space_bound(monkeypatch, rng):
+    """An oversized same-bucket batch splits into compliant
+    sub-dispatches instead of failing every lane (pinned with a
+    shrunken _MAX_FLAT_BYTES so no gigabyte allocations)."""
+    from volsync_tpu.ops import segment as seg
+    from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS as p
+    from volsync_tpu.ops.segment import BatchedSegmentHasher
+
+    h = BatchedSegmentHasher(p)
+    bufs = [rng.bytes(192 * 1024) for _ in range(5)]
+    items = [(b, len(b), True) for b in bufs]
+    want = h.hash_segments(items)  # one dispatch, unbounded
+
+    calls = []
+    real = seg.chunk_hash_segments
+
+    def spy(rows, *a, **kw):
+        calls.append(tuple(rows.shape))
+        return real(rows, *a, **kw)
+
+    monkeypatch.setattr(seg, "chunk_hash_segments", spy)
+    # bucket for 192 KiB is 256 KiB: allow at most 2 lanes per dispatch
+    monkeypatch.setattr(seg, "_MAX_FLAT_BYTES", 2 * 256 * 1024)
+    got = BatchedSegmentHasher(p).hash_segments(items)
+    assert got == want  # identical chunks/consumed per lane
+    assert len(calls) >= 3  # genuinely split
+    assert all(s[0] * s[1] <= 2 * 256 * 1024 for s in calls)

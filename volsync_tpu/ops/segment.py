@@ -62,6 +62,13 @@ from volsync_tpu.ops.sha256 import (
 )
 
 LEAF_SIZE = 4096  # == repo.blobid.LEAF_SIZE (static repo format constant)
+
+#: Largest flat [S*P] byte view one batched dispatch may address: the
+#: view is gathered with int32 indices (x64 off; TPUs index in int32).
+#: chunk_hash_segments refuses bigger batches; BatchedSegmentHasher
+#: splits them. Module constant so tests can exercise the split with
+#: small shapes.
+_MAX_FLAT_BYTES = (1 << 31) - 1
 _DOMAIN_WORD0 = int.from_bytes(b"VMRK", "big")  # "VMRK1" header, word 0
 _DOMAIN_BYTE4 = b"VMRK1"[4]
 
@@ -549,11 +556,11 @@ def chunk_hash_segments(data: jax.Array, valid_len: jax.Array,
     """
     assert align == LEAF_SIZE, "fused path requires page-aligned cuts"
     S, P = data.shape
-    if S * P >= 1 << 31:
+    if S * P > _MAX_FLAT_BYTES:
         # The flat [S*P] view is gathered with int32 indices (x64 is
         # off; TPUs index in int32) — a >=2 GiB batch silently can't.
-        # Callers split batches instead; the bench ladder respects the
-        # same bound.
+        # BatchedSegmentHasher splits batches to stay under the bound;
+        # the bench ladder respects it too.
         raise ValueError(
             f"batched dispatch of {S}x{P} bytes exceeds the int32 "
             f"index space (2 GiB); split the batch")
@@ -811,8 +818,16 @@ class BatchedSegmentHasher:
     def _hash_bucket(self, P: int, items) -> list:
         """One dispatch for same-bucket lanes (lane count padded to a
         pow2 so the jit cache sees a bounded set of (S, P) shapes;
-        padding lanes carry valid_len == 0)."""
+        padding lanes carry valid_len == 0). Batches whose PADDED shape
+        would cross the int32 index-space bound (2 GiB — see
+        chunk_hash_segments) split into compliant sub-batches."""
         import jax.numpy as jnp
+
+        max_lanes = max(1, _MAX_FLAT_BYTES // P)
+        if _pow2ceil(len(items), 1) > max_lanes:
+            half = max(1, len(items) // 2)
+            return (self._hash_bucket(P, items[:half])
+                    + self._hash_bucket(P, items[half:]))
 
         p = self.params
         cand_cap, chunk_cap = segment_caps(P, p)
