@@ -3,6 +3,12 @@
 # run this ALONE — kill every other python first; see
 # docs/performance.md "Measured dispatch economics").
 #
+# NOTE (round 5): bench.py now runs this sequence ITSELF as a recovery
+# phase (_recover_backend: stale-child SIGKILL, post-kill probe, sparse
+# quiet-wait probes), and scripts/bench_self.py writes the
+# provenance-stamped per-rung artifacts. This script remains the
+# manual, operator-driven form.
+#
 #   1. probe (hard-killed on hang; SIGTERM does not kill a client
 #      blocked in backend init)
 #   2. on-chip golden verify of the kernel surfaces (/tmp/verify_r4.py
