@@ -1,0 +1,89 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the single-tenant serving tunnel ONCE AN HOUR
+# (hammering a wedged tunnel with killed probes extends the outage —
+# docs/performance.md), and the moment a probe succeeds, run the full
+# measurement sequence serially and commit the artifacts:
+#
+#   1. scripts/bench_self.py r05      (provenance-stamped kernel rungs)
+#   2. scripts/service_bench.py       (N gRPC streams, coalesced)
+#   3. bench_scale.py fleet           (BASELINE configs[5] on hardware)
+#
+# Hard-stops at the deadline (epoch seconds, $1) so it can never
+# collide with the driver's own round-end bench run. State in
+# /tmp/tunnel_watch.state for observers.
+set -u
+cd "$(dirname "$0")/.."
+DEADLINE="${1:?usage: tunnel_watch.sh <stop-epoch-seconds>}"
+LOG=/tmp/tunnel_watch.log
+STATE=/tmp/tunnel_watch.state
+
+note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; echo "$*" > "$STATE"; }
+
+note "watch started; deadline $(date -u -d @"$DEADLINE" +%H:%M:%S)"
+while true; do
+    now=$(date +%s)
+    if [ "$now" -ge "$DEADLINE" ]; then
+        note "deadline reached; exiting (tunnel never recovered)"
+        exit 75
+    fi
+    note "probing"
+    out=$(timeout -k 10 300 python -c \
+        "import jax; print('probe-ok', jax.default_backend())" 2>&1 \
+        | tail -1)
+    if [[ "$out" == *probe-ok*axon* || "$out" == *probe-ok*tpu* ]]; then
+        note "TUNNEL LIVE ($out) — measuring"
+        break
+    fi
+    note "probe failed ($out); quiet for 55 min"
+    # bail out early if the quiet period would cross the deadline
+    if [ $(( $(date +%s) + 3300 )) -ge "$DEADLINE" ]; then
+        note "next probe would cross the deadline; exiting"
+        exit 75
+    fi
+    sleep 3300
+done
+
+budget_left=$(( DEADLINE - $(date +%s) ))
+note "measurement budget: ${budget_left}s"
+
+# 1. Kernel/engine rungs -> BENCH_SELF_r05.json (each rung self-times;
+#    bench_self sleeps 10s between rungs for session settle).
+if [ "$budget_left" -gt 2600 ]; then
+    timeout -k 20 $(( budget_left - 1500 > 7200 ? 7200 : budget_left - 1500 )) \
+        python scripts/bench_self.py r05 2>&1 | tee -a "$LOG" | tail -20
+else
+    # tight window: one primary rung only
+    timeout -k 20 $(( budget_left - 600 )) \
+        python scripts/bench_self.py r05 "B:64,8,6" 2>&1 | tee -a "$LOG" | tail -8
+fi
+
+# 2. Service concurrency (the gRPC/microbatcher path), if time remains.
+if [ $(( DEADLINE - $(date +%s) )) -gt 1400 ]; then
+    note "service_bench"
+    VOLSYNC_SVCBENCH_CLIENTS=8 VOLSYNC_SVCBENCH_MIB=64 \
+        timeout -k 20 1200 python scripts/service_bench.py \
+        > /tmp/service_bench.json 2>>"$LOG" || note "service_bench failed"
+    tail -1 /tmp/service_bench.json >> "$LOG" 2>/dev/null || true
+fi
+
+# 3. Fleet scenario (configs[5]) if time remains.
+if [ $(( DEADLINE - $(date +%s) )) -gt 2000 ]; then
+    note "bench_scale fleet"
+    VOLSYNC_SCALE_MIB=8 VOLSYNC_SCALE_CRS=50 \
+        timeout -k 20 1800 python bench_scale.py fleet \
+        > /tmp/scale_fleet.json 2>>"$LOG" || note "fleet failed"
+    tail -1 /tmp/scale_fleet.json >> "$LOG" 2>/dev/null || true
+fi
+
+# Commit whatever landed.
+git add -A BENCH_SELF_r05.json 2>/dev/null || true
+if ! git diff --cached --quiet; then
+    git commit -q -m "Live-chip measurements: BENCH_SELF_r05 (tunnel recovered mid-round)
+
+Recorded by the automated tunnel watcher the moment the wedged
+single-tenant tunnel came back; per-rung provenance in the artifact.
+
+No-Verification-Needed: automated measurement artifact, no source change" \
+        && note "committed BENCH_SELF_r05.json"
+fi
+note "watch done"
