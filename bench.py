@@ -803,7 +803,7 @@ def main():
                        "session leak); this is the labeled CPU-backend "
                        "fallback, not an accelerator number. The last "
                        "builder-run LIVE-chip measurement with full "
-                       "provenance is BENCH_SELF_r04.json")
+                       "provenance is the newest BENCH_SELF_r*.json")
         _emit(out)
         return 0
     _log("bench: every measurement path failed")

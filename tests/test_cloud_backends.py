@@ -256,3 +256,20 @@ def test_swift_missing_credentials():
     with pytest.raises(ValueError, match="ST_KEY"):
         open_store("swift:container:/p", env={
             "ST_AUTH": "http://swift/auth/v1.0", "ST_USER": "u"})
+
+
+def test_swift_temp_url_routes_same_client(swift):
+    """restic's swift-temp: URL form routes to the same client."""
+    from volsync_tpu.objstore.swift import SwiftObjectStore
+
+    srv, _ = swift
+    st = open_store("swift-temp:backups:/tmp-auth", env={
+        "OS_AUTH_URL": srv.endpoint + "/v3",
+        "OS_USERNAME": srv.username,
+        "OS_PASSWORD": srv.password,
+        "OS_PROJECT_NAME": srv.project,
+        "OS_REGION_NAME": srv.region,
+    })
+    assert isinstance(st, SwiftObjectStore)
+    st.put("k", b"v")
+    assert st.get("k") == b"v"
