@@ -46,8 +46,10 @@ def _run(cmd: list[str], env: dict, timeout: int) -> tuple[int, str, str]:
                            timeout=timeout)
         return r.returncode, r.stdout, r.stderr
     except subprocess.TimeoutExpired as e:
-        return 124, (e.stdout or b"").decode(errors="replace") if isinstance(
-            e.stdout, bytes) else (e.stdout or ""), "TIMEOUT"
+        out = e.stdout or ""
+        if isinstance(out, bytes):  # TimeoutExpired ignores text=True
+            out = out.decode(errors="replace")
+        return 124, out, "TIMEOUT"
 
 
 def _provenance() -> dict:

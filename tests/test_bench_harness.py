@@ -119,8 +119,7 @@ def test_recovery_kills_only_stale_inner_children():
     sentinel = f"VOLSYNC_BENCH_TEST_{os.getpid()}"
     stale = subprocess.Popen(
         [sys.executable, "-c", "import time; time.sleep(120)"],
-        env={**os.environ, sentinel.split("=")[0]: "1",
-             "VOLSYNC_BENCH_SENTINEL": sentinel})
+        env={**os.environ, "VOLSYNC_BENCH_SENTINEL": sentinel})
     bystander = subprocess.Popen(
         [sys.executable, "-c", "import time; time.sleep(120)"],
         env=dict(os.environ))
