@@ -384,7 +384,7 @@ def _config_deadline_s() -> int:
 
 
 def _try_batched_throughput(seg_mib: int, streams: int, iters: int,
-                            pipelines: int = 2) -> float:
+                            pipelines: Optional[int] = None) -> float:
     """The cross-PVC batched dispatch (ops/segment.chunk_hash_segments):
     all streams' segments in ONE device program per iteration — no
     per-stream dispatch/fetch round-trips at all. Lane content is the
@@ -394,7 +394,10 @@ def _try_batched_throughput(seg_mib: int, streams: int, iters: int,
     per-dispatch cost (~7 ms execution overhead + ~80 ms result round
     trip through the serving tunnel, measured r4) with device compute —
     the same overlap the shipped SegmentMicroBatcher gets from
-    concurrent movers."""
+    concurrent movers. Default 2; VOLSYNC_BENCH_PIPELINES overrides so
+    bench_self rungs can A/B the depth on hardware."""
+    if pipelines is None:
+        pipelines = int(os.environ.get("VOLSYNC_BENCH_PIPELINES", "2"))
     import functools as _ft
     from concurrent.futures import ThreadPoolExecutor
 

@@ -34,10 +34,16 @@ DEFAULT_RUNGS = [
     "B:64,8,6",                       # primary batched shape (r4 rung 1)
     "B:128,8,3",                      # 2x bytes per dispatch (segment)
     "B:64,16,3",                      # 2x bytes per dispatch (lanes)
+    "VOLSYNC_BENCH_PIPELINES=3:B:64,8,6",  # dispatch-overlap depth A/B
     "VOLSYNC_PAGEMAJOR=1:B:64,8,6",   # page-major digest-table A/B
     "S:64,8,6",                       # per-stream fused shape, same size
 ]
 RUNG_BUDGET_S = int(os.environ.get("VOLSYNC_SELF_RUNG_BUDGET", "1100"))
+
+#: A/B knobs rung specs may set: stripped from the ambient environment
+#: so a leftover export can't silently skew the baseline rungs or break
+#: the artifact's verbatim-command reproducibility.
+AB_KNOBS = ("VOLSYNC_BENCH_PIPELINES", "VOLSYNC_PAGEMAJOR")
 
 
 def _run(cmd: list[str], env: dict, timeout: int) -> tuple[int, str, str]:
@@ -105,7 +111,8 @@ def main() -> int:
     best = None
     for spec in rungs:
         extra_env, config = _parse_rung(spec)
-        env = dict(os.environ, VOLSYNC_BENCH_INNER="1",
+        base = {k: v for k, v in os.environ.items() if k not in AB_KNOBS}
+        env = dict(base, VOLSYNC_BENCH_INNER="1",
                    VOLSYNC_BENCH_CONFIG=config,
                    VOLSYNC_BENCH_BUDGET_S=str(RUNG_BUDGET_S),
                    VOLSYNC_BENCH_CONFIG_DEADLINE=str(RUNG_BUDGET_S - 200),
