@@ -686,6 +686,140 @@ def cpu_baseline(total_mib: int = 64) -> float:
     return n / dt
 
 
+class _HostSegmentHasher:
+    """Fixed-grid host chunk+hash stand-in for the device stage, used by
+    the pipeline bench: on a CPU backend the XLA sha256 path runs at
+    ~4 MiB/s, which would drown the read/seal/upload overlap this bench
+    exists to measure (on a TPU the device stage is sub-ms per segment
+    and the same overlap applies). Conforms to stream_chunks' plain
+    hasher protocol: process() -> [(start, length, digest)]."""
+
+    def __init__(self, chunk_size: int = 1 << 20):
+        self.chunk_size = chunk_size
+
+    def process(self, buffer, *, eof: bool = True):
+        import hashlib
+
+        data = buffer.tobytes()
+        end = (len(data) if eof
+               else (len(data) // self.chunk_size) * self.chunk_size)
+        out = []
+        for pos in range(0, end, self.chunk_size):
+            ln = min(self.chunk_size, end - pos)
+            out.append((pos, ln,
+                        hashlib.sha256(data[pos:pos + ln]).hexdigest()))
+        return out
+
+
+def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
+                   segment_mib: int = 2) -> dict:
+    """Serial-vs-pipelined backup data plane (``bench.py pipeline``).
+
+    Streams a ``total_mib`` volume through stream_chunks ->
+    Repository.add_blob -> flush twice — once with
+    VOLSYNC_TPU_PIPELINE=0 semantics (inline seal, synchronous put) and
+    once with the full pipeline (read-ahead thread, seal pool, bounded
+    async upload window) — over a MemObjectStore wrapped in LatencyStore
+    so every put costs ``put_latency_s`` like a real object store.
+    Reports wall times, speedup, and the per-stage breakdown
+    (read / device / seal / upload) from the obs span registry.
+
+    Two measurement details matter on small hosts: a short pipelined
+    warmup run is done first so thread-pool creation and module imports
+    are not billed to the timed runs, and the interpreter switch
+    interval is lowered for the duration of the bench — at the default
+    5 ms a single-core box pays up to one full interval per cross-thread
+    future/queue handoff, which swamps the IO latency the pipeline is
+    hiding."""
+    from volsync_tpu.engine.chunker import stream_chunks
+    from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
+    from volsync_tpu.obs import reset_spans, span_totals
+    from volsync_tpu.ops.gearcdc import GearParams
+    from volsync_tpu.repo.repository import Repository
+
+    total = total_mib << 20
+    seg_size = segment_mib << 20
+    data = _make_data(total, redundancy=0.0).tobytes()
+    params = GearParams(min_size=256 * 1024, avg_size=512 * 1024,
+                        max_size=1024 * 1024, seed=7, align=4096)
+
+    def run(pipelined: bool, limit: int = 0):
+        store = LatencyStore(MemObjectStore(), put_latency=put_latency_s)
+        repo = Repository.init(store)
+        repo.pipelined = pipelined
+        repo.PACK_TARGET = 1024 * 1024
+        end = limit or total
+        pos = 0
+
+        def reader(n):
+            nonlocal pos
+            piece = data[pos:min(pos + n, end)]
+            pos += len(piece)
+            return piece
+
+        reset_spans()
+        t0 = time.perf_counter()
+        for chunk, digest in stream_chunks(
+                reader, params, segment_size=seg_size,
+                hasher=_HostSegmentHasher(),
+                readahead=(2 if pipelined else 0)):
+            repo.add_blob("data", digest, chunk)
+        repo.flush()
+        return time.perf_counter() - t0, span_totals(), store
+
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
+    try:
+        run(True, limit=4 << 20)  # warmup: pools, imports, first-call paths
+        serial_s, serial_spans, _ = run(False)
+        pipe_s, pipe_spans, pipe_store = run(True)
+    finally:
+        sys.setswitchinterval(prev_switch)
+
+    def stages(spans):
+        return {name: round(spans.get(key, (0, 0.0))[1], 4)
+                for name, key in (("read", "engine.read"),
+                                  ("device", "engine.device"),
+                                  ("seal", "repo.seal"),
+                                  ("upload", "repo.pack_upload"),
+                                  ("upload_wait", "repo.upload_wait"))}
+
+    return {
+        "metric": "pipeline_backup_speedup",
+        "value": round(serial_s / pipe_s, 2),
+        "unit": "x",
+        "serial_s": round(serial_s, 3),
+        "pipelined_s": round(pipe_s, 3),
+        "throughput_mib_s": round(total_mib / pipe_s, 1),
+        "segments": total_mib // segment_mib,
+        "packs_uploaded": pipe_store.puts,
+        "max_concurrent_puts": pipe_store.max_concurrent_puts,
+        "put_latency_ms": round(put_latency_s * 1000, 1),
+        "stages": stages(pipe_spans),
+        "stages_serial": stages(serial_spans),
+    }
+
+
+def _pipeline_child(timeout_s: int = 180):
+    """Run ``bench.py pipeline`` in a killable CPU-pinned subprocess and
+    parse its JSON line; None on any failure (the main metric must
+    never be lost to the stage-breakdown extra)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("VOLSYNC_BENCH_INNER", None)
+    try:
+        r = subprocess.run([sys.executable, __file__, "pipeline"],
+                           timeout=timeout_s, capture_output=True,
+                           text=True, env=env)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
 def _inner_main():
     """Measure in THIS process. The parent decided the backend
     (VOLSYNC_BENCH_CPU_FALLBACK selects the CPU path); any failure —
@@ -766,6 +900,12 @@ def _run_measurement_child(extra_env: dict, timeout_s: int) -> Optional[dict]:
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "pipeline":
+        # Standalone stage-breakdown mode; host-side only, so pin the
+        # backend to CPU before anything imports jax.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _emit(pipeline_bench())
+        return 0
     if os.environ.get("VOLSYNC_BENCH_INNER"):
         return _inner_main()
     threading.Thread(target=_watchdog, daemon=True).start()
@@ -784,6 +924,10 @@ def main():
             if measure_s >= 300:
                 out = _run_measurement_child({}, measure_s)
                 if out is not None:
+                    if _budget_left() > 300:
+                        pipe = _pipeline_child()
+                        if pipe is not None:
+                            out["pipeline"] = pipe
                     _emit(out)
                     return 0
                 _log("bench: device measurement failed — CPU-backend "
@@ -800,6 +944,10 @@ def main():
     out = _run_measurement_child({"VOLSYNC_BENCH_CPU_FALLBACK": "1"},
                                  CPU_MEASURE_TIMEOUT_S)
     if out is not None:
+        if _budget_left() > 300:
+            pipe = _pipeline_child()
+            if pipe is not None:
+                out["pipeline"] = pipe
         out["backend"] = "cpu-fallback"
         out["note"] = ("TPU backend unreachable at bench time (see "
                        "docs/performance.md: single-tenant tunnel "

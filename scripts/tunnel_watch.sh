@@ -51,10 +51,16 @@ note "measurement budget: ${budget_left}s"
 if [ "$budget_left" -gt 2600 ]; then
     timeout -k 20 $(( budget_left - 1500 > 7200 ? 7200 : budget_left - 1500 )) \
         python scripts/bench_self.py r05 2>&1 | tee -a "$LOG" | tail -20
-else
-    # tight window: one primary rung only
-    timeout -k 20 $(( budget_left - 600 )) \
+elif [ "$budget_left" -gt 700 ]; then
+    # tight window: one primary rung only; floor the duration at 60s —
+    # budget_left-600 could otherwise reach 0/negative, which GNU
+    # timeout treats as error/no-timeout
+    dur=$(( budget_left - 600 ))
+    [ "$dur" -lt 60 ] && dur=60
+    timeout -k 20 "$dur" \
         python scripts/bench_self.py r05 "B:64,8,6" 2>&1 | tee -a "$LOG" | tail -8
+else
+    note "budget ${budget_left}s too tight for any rung; skipping bench_self"
 fi
 
 # 2. Service concurrency (the gRPC/microbatcher path), if time remains.

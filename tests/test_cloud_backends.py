@@ -258,8 +258,81 @@ def test_swift_missing_credentials():
             "ST_AUTH": "http://swift/auth/v1.0", "ST_USER": "u"})
 
 
+def test_swift_unsupported_credential_families():
+    """A Secret built around Keystone families this backend doesn't
+    implement (application credentials, id-scoping, trusts) is refused
+    by NAME — not with a misleading 'OS_USERNAME missing'."""
+    with pytest.raises(ValueError, match="OS_APPLICATION_CREDENTIAL_ID"):
+        open_store("swift:container:/p", env={
+            "OS_AUTH_URL": "http://keystone/v3",
+            "OS_APPLICATION_CREDENTIAL_ID": "acid",
+            "OS_APPLICATION_CREDENTIAL_SECRET": "acsecret"})
+    with pytest.raises(ValueError, match="OS_USER_ID, OS_TENANT_ID"):
+        open_store("swift:container:/p", env={
+            "OS_AUTH_URL": "http://keystone/v3",
+            "OS_USER_ID": "uid", "OS_PASSWORD": "pw",
+            "OS_TENANT_ID": "tid"})
+    # the plain missing-credentials message still names what's missing
+    with pytest.raises(ValueError, match="OS_USERNAME"):
+        open_store("swift:container:/p", env={
+            "OS_AUTH_URL": "http://keystone/v3",
+            "OS_PASSWORD": "pw", "OS_PROJECT_NAME": "proj"})
+
+
+@pytest.mark.parametrize("backend", ["s3", "azure", "swift"])
+def test_list_empty_prefix_contract(backend):
+    """Cross-backend contract: list("") on a prefixed store yields
+    exactly the store's own keys, correctly stripped — never objects of
+    a sibling prefix sharing the same string head (the swift/azure bug:
+    prefix joined without a trailing '/')."""
+    from contextlib import ExitStack
+
+    with ExitStack() as stack:
+        if backend == "s3":
+            from volsync_tpu.objstore.fakes3 import FakeS3Server
+            from volsync_tpu.objstore.s3 import S3ObjectStore
+
+            srv = stack.enter_context(FakeS3Server())
+
+            def mk(p):
+                return S3ObjectStore(srv.endpoint, "bucket", p,
+                                     access_key=srv.access_key,
+                                     secret_key=srv.secret_key)
+        elif backend == "azure":
+            srv = stack.enter_context(FakeAzureServer())
+
+            def mk(p):
+                return AzureBlobStore(srv.endpoint, srv.account,
+                                      srv.key_b64, "backups", p)
+        else:
+            from volsync_tpu.objstore.fakeswift import FakeSwiftServer
+
+            srv = stack.enter_context(FakeSwiftServer())
+            env = {
+                "OS_AUTH_URL": srv.endpoint + "/v3",
+                "OS_USERNAME": srv.username,
+                "OS_PASSWORD": srv.password,
+                "OS_PROJECT_NAME": srv.project,
+                "OS_REGION_NAME": srv.region,
+            }
+
+            def mk(p):
+                return open_store(f"swift:backups:/{p}", env=env)
+
+        a, b = mk("ns/repo"), mk("ns/repo-sibling")
+        a.put("config", b"a")
+        a.put("data/00/obj", b"a")
+        b.put("config", b"b")
+        b.put("data/00/other", b"b")
+        assert sorted(a.list("")) == ["config", "data/00/obj"]
+        assert sorted(b.list("")) == ["config", "data/00/other"]
+        assert list(a.list("data/")) == ["data/00/obj"]
+
+
 def test_swift_temp_url_routes_same_client(swift):
-    """restic's swift-temp: URL form routes to the same client."""
+    """The swift-temp: alias (a volsync-tpu convenience for temp-auth
+    deployments — not a restic location scheme) routes to the same
+    client as swift:."""
     from volsync_tpu.objstore.swift import SwiftObjectStore
 
     srv, _ = swift

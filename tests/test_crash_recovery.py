@@ -91,6 +91,67 @@ def test_backup_crash_then_retry_restores(tmp_path, src_tree):
     assert repo_c.check(read_data=True) == []
 
 
+def test_pipelined_crash_before_flush_no_dangling_index(tmp_path, src_tree):
+    """A pipelined backup abandoned before flush() (pod killed) may have
+    uploaded packs, but no index delta or snapshot referencing them can
+    exist — orphan packs stay invisible, exactly like the serial path."""
+    root = tmp_path / "store"
+    fs = FsObjectStore(str(root))
+    Repository.init(fs, chunker=CHUNKER)
+
+    repo = Repository.open(fs)
+    repo.pipelined = True  # the scenario under test, whatever the env says
+    repo.PACK_TARGET = 64 * 1024
+    rng = np.random.RandomState(9)
+    from volsync_tpu.repo import blobid
+    for _ in range(20):
+        data = rng.bytes(30_000)
+        repo.add_blob("data", blobid.blob_id(data), data)
+    # simulate the crash: join in-flight uploads (the pod's sockets may
+    # well have completed) but never call flush() — no index persist
+    with repo._lock:
+        futs = [pk.fut for pk in repo._pl_inflight]
+    for f in futs:
+        f.result()
+
+    assert list(fs.list("index/")) == []
+    assert list(fs.list("snapshots/")) == []
+    # the restarted pod opens a consistent, empty-looking repo
+    fresh = Repository.open(fs)
+    assert fresh.list_snapshots() == []
+    assert fresh.check(read_data=True) == []
+    # and a clean retry fully restores
+    snap, _ = TreeBackup(fresh, workers=2).run(src_tree)
+    dst = tmp_path / "dst"
+    restore_snapshot(Repository.open(fs), dst)
+    for f in sorted(p.name for p in src_tree.iterdir()):
+        assert (dst / f).read_bytes() == (src_tree / f).read_bytes(), f
+
+
+def test_pipelined_upload_failure_surfaces_on_flush(tmp_path, src_tree):
+    """The async upload stage must not swallow store failures: a dying
+    store surfaces as an exception at or before flush(), and the index
+    never points at the packs that were dropped mid-flight."""
+    root = tmp_path / "store"
+    fs = FsObjectStore(str(root))
+    Repository.init(fs, chunker=CHUNKER)
+
+    dying = DyingStore(fs, die_after_packs=1)
+    repo = Repository.open(dying)
+    repo.pipelined = True
+    repo.PACK_TARGET = 64 * 1024
+    rng = np.random.RandomState(10)
+    from volsync_tpu.repo import blobid
+    with pytest.raises(Exception, match="simulated mover crash"):
+        for _ in range(30):
+            data = rng.bytes(30_000)
+            repo.add_blob("data", blobid.blob_id(data), data)
+        repo.flush()
+    assert dying.dead
+    assert list(fs.list("index/")) == []
+    assert Repository.open(fs).check(read_data=True) == []
+
+
 def test_prune_sweeps_crash_orphans(tmp_path, src_tree):
     root = tmp_path / "store"
     fs = FsObjectStore(str(root))

@@ -1,0 +1,233 @@
+"""Pipelined backup data plane (repo/repository.py + engine/chunker.py).
+
+The pipeline overlaps read-ahead, sealing, and uploads behind the same
+repository API the serial path uses, so the contract is strong:
+
+  * golden byte-identity — the object store a pipelined backup produces
+    (packs, index deltas, snapshot) is bit-for-bit the store the serial
+    path produces for the same input stream;
+  * failure semantics — a `store.put` failure surfaces as UploadError at
+    or before flush(), and the persisted index never references a pack
+    that is not in the store;
+  * backpressure — the seal queue and the upload in-flight window stay
+    within their configured bounds, so buffered bytes are bounded.
+"""
+
+import numpy as np
+import pytest
+
+from volsync_tpu import envflags
+from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
+from volsync_tpu.repo import blobid
+from volsync_tpu.repo.repository import BackupStats, Repository, UploadError
+
+SNAP_TIME = "2026-01-02T03:04:05+00:00"
+
+
+def _blobs(n=40, size=3000, seed=5):
+    rng = np.random.RandomState(seed)
+    return [(d, blobid.blob_id(d)) for d in (rng.bytes(size) for _ in range(n))]
+
+
+def _backup(pipelined: bool, blobs, store=None, pack_target=16 * 1024,
+            snapshot=True):
+    store = store if store is not None else MemObjectStore()
+    repo = Repository.init(store)
+    repo.pipelined = pipelined
+    repo.PACK_TARGET = pack_target
+    stats = BackupStats()
+    for data, bid in blobs:
+        repo.add_blob("data", bid, data, stats=stats)
+    repo.flush()
+    if snapshot:
+        repo.save_snapshot({"tree": blobs[0][1], "time": SNAP_TIME})
+    return repo, stats
+
+
+def _objects(store, skip=("config",)):
+    return {k: store.get(k) for k in store.list("") if k not in skip}
+
+
+class FailingStore:
+    """Delegating store whose data-pack puts fail from pack number
+    ``fail_from`` (1-based) onward; everything else succeeds."""
+
+    def __init__(self, inner, fail_from=1):
+        self._inner = inner
+        self._fail_from = fail_from
+        self.pack_puts = 0
+
+    def put(self, key, data):
+        if key.startswith("data/"):
+            self.pack_puts += 1
+            if self.pack_puts >= self._fail_from:
+                raise IOError(f"injected put failure (pack #{self.pack_puts})")
+        self._inner.put(key, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# -- golden byte-identity ----------------------------------------------------
+
+def test_golden_store_equality_pipelined_vs_serial():
+    """Every object the pipelined backup persists — packs, index deltas,
+    snapshot — is byte-identical to the serial path's (config differs by
+    its random repository id, nothing else may)."""
+    blobs = _blobs()
+    # interleave duplicates so the dedup path runs in both modes
+    stream = blobs + blobs[:7] + blobs[20:25]
+    repo_s, st_a = _backup(False, stream)
+    repo_p, st_p = _backup(True, stream)
+    a, b = _objects(repo_s.store), _objects(repo_p.store)
+    assert sorted(a) == sorted(b)
+    for key in a:
+        assert a[key] == b[key], f"object {key} differs pipelined vs serial"
+    assert any(k.startswith("data/") for k in a)
+    assert any(k.startswith("index/") for k in a)
+    assert any(k.startswith("snapshots/") for k in a)
+    # stats parity: both modes account new/dedup/stored bytes identically
+    assert st_p.as_dict() == st_a.as_dict()
+
+
+def test_pipeline_env_flag_disables(monkeypatch):
+    monkeypatch.setenv("VOLSYNC_TPU_PIPELINE", "0")
+    repo = Repository.init(MemObjectStore())
+    assert repo.pipelined is False
+    assert envflags.readahead_segments() == 0
+    monkeypatch.setenv("VOLSYNC_TPU_PIPELINE", "1")
+    assert Repository.init(MemObjectStore()).pipelined is True
+    assert envflags.readahead_segments() >= 1
+
+
+def test_read_blob_while_buffered():
+    """Blobs are readable at every pipeline stage: still sealing
+    (_pl_open), upload in flight (_pl_inflight), and after flush."""
+    blobs = _blobs(n=12)
+    store = MemObjectStore()
+    repo = Repository.init(store)
+    repo.pipelined = True
+    repo.PACK_TARGET = 16 * 1024
+    for data, bid in blobs:
+        repo.add_blob("data", bid, data)
+        assert repo.read_blob(bid) == data  # mid-pipeline read
+    repo.flush()
+    for data, bid in blobs:
+        assert repo.read_blob(bid) == data
+
+
+def test_readahead_stream_identical_chunks():
+    """Chunk boundaries and digests are invariant under read-ahead: the
+    producer thread changes WHEN pieces are read, never what the device
+    sees."""
+    from volsync_tpu.engine.chunker import stream_chunks
+    from volsync_tpu.ops.gearcdc import GearParams
+
+    rng = np.random.RandomState(11)
+    data = rng.bytes(768 * 1024)
+    params = GearParams(min_size=4096, avg_size=32768, max_size=65536,
+                        seed=7, align=4096)
+
+    def run(readahead):
+        pos = 0
+
+        def reader(n):
+            nonlocal pos
+            piece = data[pos:pos + n]
+            pos += len(piece)
+            return piece
+
+        return list(stream_chunks(reader, params,
+                                  segment_size=128 * 1024,
+                                  readahead=readahead))
+
+    serial, ahead = run(0), run(3)
+    assert [d for _, d in serial] == [d for _, d in ahead]
+    assert b"".join(c for c, _ in ahead) == data
+
+
+# -- failure semantics -------------------------------------------------------
+
+def test_upload_failure_surfaces_at_or_before_flush():
+    blobs = _blobs(n=30)
+    store = FailingStore(MemObjectStore(), fail_from=1)
+    repo = Repository.init(store)
+    repo.pipelined = True
+    repo.PACK_TARGET = 16 * 1024
+    with pytest.raises(UploadError, match="injected put failure"):
+        for data, bid in blobs:
+            repo.add_blob("data", bid, data)
+        repo.flush()
+    # nothing durable may reference the failed packs
+    assert list(store.list("index/")) == []
+    assert list(store.list("snapshots/")) == []
+
+
+def test_upload_failure_never_leaves_dangling_index_entry():
+    """First pack lands and its index delta persists mid-run; the second
+    pack's upload fails. The persisted index must reference only packs
+    that exist — a fresh open sees a consistent (if partial) repo."""
+    blobs = _blobs(n=60)
+    inner = MemObjectStore()
+    store = FailingStore(inner, fail_from=2)
+    repo = Repository.init(store)
+    repo.pipelined = True
+    repo.PACK_TARGET = 16 * 1024
+    repo.PENDING_INDEX_LIMIT = 1  # persist each reaped pack immediately
+    with pytest.raises(UploadError, match="injected put failure"):
+        for data, bid in blobs:
+            repo.add_blob("data", bid, data)
+        repo.flush()
+    packs = {k.rsplit("/", 1)[1] for k in inner.list("data/")}
+    assert packs, "the first pack should have landed"
+    fresh = Repository.open(inner)
+    with fresh._lock:
+        referenced = {p for p in fresh._index.live_packs() if p}
+    assert referenced <= packs, (
+        f"index references missing packs: {referenced - packs}")
+    assert fresh.check(read_data=True) == []
+
+
+def test_upload_retry_recovers_transient_failure():
+    class FlakyStore:
+        def __init__(self, inner):
+            self._inner = inner
+            self.failures = 0
+
+        def put(self, key, data):
+            if key.startswith("data/") and self.failures == 0:
+                self.failures += 1
+                raise IOError("transient blip")
+            self._inner.put(key, data)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    blobs = _blobs(n=30)
+    inner = MemObjectStore()
+    repo = Repository.init(FlakyStore(inner))
+    repo.pipelined = True
+    repo.PACK_TARGET = 16 * 1024
+    for data, bid in blobs:
+        repo.add_blob("data", bid, data)
+    repo.flush()  # retry inside _upload_pack absorbs the single failure
+    assert Repository.open(inner).check(read_data=True) == []
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_backpressure_bounds_queues(monkeypatch):
+    monkeypatch.setenv("VOLSYNC_TPU_SEAL_QUEUE", "2")
+    monkeypatch.setenv("VOLSYNC_TPU_UPLOAD_WINDOW", "2")
+    store = LatencyStore(MemObjectStore(), put_latency=0.01)
+    repo = Repository.init(store)
+    repo.pipelined = True
+    repo.PACK_TARGET = 16 * 1024
+    for data, bid in _blobs(n=60):
+        repo.add_blob("data", bid, data)
+        # add_blob drains until the seal queue is under its limit
+        assert len(repo._pl_open) <= 2
+    repo.flush()
+    assert store.puts >= 4
+    assert store.max_concurrent_puts <= 2
+    assert repo.check(read_data=True) == []

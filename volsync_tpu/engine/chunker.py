@@ -15,10 +15,14 @@ whole stream (see ops/gearcdc.py).
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Callable, Iterator, Optional
 
 import numpy as np
 
+from volsync_tpu import envflags
+from volsync_tpu.obs import span
 from volsync_tpu.repo import blobid
 
 from volsync_tpu.ops.gearcdc import (
@@ -569,9 +573,76 @@ def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
     return blobid.root_from_leaves(total, leaves)
 
 
+class _ReadaheadStream:
+    """Read-ahead stage of the backup pipeline: a producer thread
+    prefetches ``reader(piece_size)`` pieces into a bounded queue so the
+    next segment's host read overlaps the current segment's device
+    round-trip. Complements the native double-buffer (_open_readahead),
+    which only covers file readers — this wraps ANY reader callable
+    (block devices, sockets, tar streams). Reader exceptions propagate
+    to the consumer; ``close()`` (or consumer GC) stops the thread."""
+
+    def __init__(self, reader: Callable[[int], bytes], piece_size: int,
+                 depth: int):
+        from volsync_tpu.metrics import GLOBAL as _METRICS
+
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._reader = reader
+        self._piece = piece_size
+        self._eof = False
+        self._gauge = _METRICS.pipeline_depth.labels(stage="read")
+        self._thread = threading.Thread(
+            target=self._produce, daemon=True, name="vtpk-readahead")
+        self._thread.start()
+
+    def _produce(self):
+        try:
+            while not self._stop.is_set():
+                with span("engine.read"):
+                    piece = self._reader(self._piece)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(piece, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue  # poll stop: a closed consumer must
+                        # not leave this thread blocked forever
+                self._gauge.set(self._q.qsize())
+                if not piece:
+                    return
+        except Exception as ex:  # noqa: BLE001 — re-raised by read()
+            while not self._stop.is_set():
+                try:
+                    self._q.put(ex, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+    def read(self, n: int) -> bytes:
+        """Queue-fed drop-in for the wrapped reader. ``n`` is ignored:
+        pieces come back in the producer's piece_size granularity, which
+        only changes call boundaries, never stream content."""
+        if self._eof:
+            return b""
+        item = self._q.get()
+        self._gauge.set(self._q.qsize())
+        if isinstance(item, Exception):
+            self._eof = True
+            raise item
+        if not item:
+            self._eof = True
+        return item
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
 def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
                   segment_size: int = 32 * 1024 * 1024,
                   hasher: Optional[DeviceChunkHasher] = None,
+                  readahead: Optional[int] = None,
                   ) -> Iterator[tuple[bytes, str]]:
     """Chunk an arbitrary-length stream -> (chunk bytes, sha256 hex).
 
@@ -589,39 +660,61 @@ def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
     segment size. 64 <= align < 4096 keeps the split-phase pipeline
     (synchronous boundary walk, leaf digests in flight across loop
     iterations); align=1 the legacy synchronous path.
+
+    ``readahead`` (default: env VOLSYNC_TPU_READAHEAD, 0 under
+    VOLSYNC_TPU_PIPELINE=0) prefetches that many pieces of the stream
+    on a producer thread so host reads overlap device work — the
+    read-ahead stage of the backup pipeline. Chunk boundaries and
+    digests are identical either way.
     """
     hasher = hasher or DeviceChunkHasher(params)
-    pending = b""
-    eof = False
-    prev: Optional[tuple[bytes, object]] = None  # (segment bytes, pending token)
-    while True:
-        while not eof and len(pending) < segment_size + params.max_size:
-            piece = reader(segment_size)
-            if not piece:
-                eof = True
-            else:
-                pending += piece
-        begin = getattr(hasher, "begin", None)
-        if begin is not None:
-            token = begin(np.frombuffer(pending, np.uint8), eof=eof)
-        else:
-            # Engines without split-phase support (e.g. the mesh hasher)
-            # still work, just without the overlap.
-            token = PendingSegment(hasher.process(
-                np.frombuffer(pending, np.uint8), eof=eof), None, None)
-        consumed = token.end
-        if prev is not None:
-            seg_bytes, prev_token = prev
-            for start, length, digest in prev_token.finish():
-                yield seg_bytes[start: start + length], digest
-        prev = (pending, token)
-        pending = pending[consumed:]
-        if eof:
-            seg_bytes, last = prev
-            for start, length, digest in last.finish():
-                yield seg_bytes[start: start + length], digest
-            return
-        # A non-eof pass over more than max_size bytes always emits at
-        # least one chunk (max_size forces a cut), so progress is
-        # guaranteed; assert to fail loudly rather than loop forever.
-        assert consumed > 0, "chunker made no progress"
+    if readahead is None:
+        readahead = envflags.readahead_segments()
+    ra: Optional[_ReadaheadStream] = None
+    if readahead > 0:
+        ra = _ReadaheadStream(reader, segment_size, readahead)
+        reader = ra.read
+    try:
+        pending = b""
+        eof = False
+        prev: Optional[tuple[bytes, object]] = None  # (segment bytes, pending token)
+        while True:
+            while not eof and len(pending) < segment_size + params.max_size:
+                piece = reader(segment_size)
+                if not piece:
+                    eof = True
+                else:
+                    pending += piece
+            begin = getattr(hasher, "begin", None)
+            with span("engine.device"):
+                if begin is not None:
+                    token = begin(np.frombuffer(pending, np.uint8), eof=eof)
+                else:
+                    # Engines without split-phase support (e.g. the mesh
+                    # hasher) still work, just without the overlap.
+                    token = PendingSegment(hasher.process(
+                        np.frombuffer(pending, np.uint8), eof=eof),
+                        None, None)
+            consumed = token.end
+            if prev is not None:
+                seg_bytes, prev_token = prev
+                with span("engine.device"):
+                    cuts = list(prev_token.finish())
+                for start, length, digest in cuts:
+                    yield seg_bytes[start: start + length], digest
+            prev = (pending, token)
+            pending = pending[consumed:]
+            if eof:
+                seg_bytes, last = prev
+                with span("engine.device"):
+                    cuts = list(last.finish())
+                for start, length, digest in cuts:
+                    yield seg_bytes[start: start + length], digest
+                return
+            # A non-eof pass over more than max_size bytes always emits at
+            # least one chunk (max_size forces a cut), so progress is
+            # guaranteed; assert to fail loudly rather than loop forever.
+            assert consumed > 0, "chunker made no progress"
+    finally:
+        if ra is not None:
+            ra.close()

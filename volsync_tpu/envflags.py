@@ -1,9 +1,11 @@
-"""One home for boolean env-knob parsing.
+"""One home for env-knob parsing.
 
 Every operational toggle (VOLSYNC_DEVICE_VERIFY, VOLSYNC_SPARSE,
 VOLSYNC_BATCH_SEGMENTS, ...) parses through here so the falsy-token
 set cannot drift between copies — "off" disabling one knob but
-enabling another is exactly the bug class this prevents.
+enabling another is exactly the bug class this prevents. The backup
+pipeline's depth/worker knobs (VOLSYNC_TPU_PIPELINE and friends) live
+here too, as the single catalogue of operator-facing tunables.
 """
 
 from __future__ import annotations
@@ -19,3 +21,56 @@ def env_bool(name: str, default: bool = False) -> bool:
     if raw is None:
         return default
     return raw.strip().lower() not in _FALSY
+
+
+def env_int(name: str, default: int, minimum: int = 0) -> int:
+    """Integer knob; unset/unparsable -> ``default``, floored at
+    ``minimum`` (a malformed operator value degrades to the default
+    instead of crashing the mover mid-sync)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return max(minimum, int(raw.strip()))
+    except ValueError:
+        return default
+
+
+# -- backup data-plane pipeline knobs (repo/repository.py, engine/chunker.py)
+
+def pipeline_enabled() -> bool:
+    """Master switch for the pipelined backup data plane.
+    ``VOLSYNC_TPU_PIPELINE=0`` falls back to the fully serial path."""
+    return env_bool("VOLSYNC_TPU_PIPELINE", True)
+
+
+def seal_workers() -> int:
+    """Worker threads for async pack sealing (zstd+AES are pure CPU and
+    release the GIL inside zstd)."""
+    return env_int("VOLSYNC_TPU_SEAL_WORKERS", 2, minimum=1)
+
+
+def seal_queue_limit() -> int:
+    """Max blobs queued for sealing per repository before add_blob
+    blocks — the backpressure bound on raw bytes held by the seal
+    stage."""
+    return env_int("VOLSYNC_TPU_SEAL_QUEUE", 16, minimum=1)
+
+
+def upload_window() -> int:
+    """Max sealed packs in flight to the object store per repository."""
+    return env_int("VOLSYNC_TPU_UPLOAD_WINDOW", 4, minimum=1)
+
+
+def upload_retries() -> int:
+    """Retries (with exponential backoff) per failed pack upload before
+    the error surfaces on the caller."""
+    return env_int("VOLSYNC_TPU_UPLOAD_RETRIES", 2, minimum=0)
+
+
+def readahead_segments() -> int:
+    """Segments prefetched ahead of the device stage by stream_chunks'
+    read-ahead thread; 0 disables the thread (inline reads)."""
+    if not pipeline_enabled():
+        return 0
+    return env_int("VOLSYNC_TPU_READAHEAD", 2, minimum=0)

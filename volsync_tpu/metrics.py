@@ -50,6 +50,16 @@ class Metrics:
             "Device data-plane throughput of the last completed transfer",
             LABELS, registry=self.registry,
         )
+        # Backup-pipeline occupancy (repo/repository.py, engine/chunker.py):
+        # per-stage queue depths, updated at every enqueue/dequeue. Stages:
+        # "read" (segments prefetched ahead of the device), "seal" (blobs
+        # queued for zstd+AES), "upload" (sealed packs in flight to the
+        # object store).
+        self.pipeline_depth = Gauge(
+            "volsync_pipeline_queue_depth",
+            "Current occupancy of each backup-pipeline stage queue",
+            ["stage"], registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":

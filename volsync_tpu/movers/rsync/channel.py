@@ -100,10 +100,10 @@ class Framed:
     def __init__(self, sock: socket.socket, box: SecretBox):
         self.sock = sock
         self.box = box
-        import zstandard
+        from volsync_tpu.repo.compress import Compressor, Decompressor
 
-        self._c = zstandard.ZstdCompressor(level=3)
-        self._d = zstandard.ZstdDecompressor()
+        self._c = Compressor(level=3)
+        self._d = Decompressor()
 
     def send(self, obj) -> None:
         body = msgpack.packb(obj, use_bin_type=True)
@@ -128,14 +128,14 @@ class Framed:
             raise ChannelError("empty frame")
         flag, body = plain[:1], plain[1:]
         if flag == _FLAG_ZSTD:
-            import zstandard
+            from volsync_tpu.repo.compress import CompressError
 
             try:
                 # bound decompressed size: a corrupt or oversized frame
                 # must not OOM us (the peer is inside the auth envelope)
                 body = self._d.decompress(body,
                                           max_output_size=_MAX_FRAME)
-            except zstandard.ZstdError as e:
+            except CompressError as e:
                 raise ChannelError(f"bad compressed frame: {e}") from None
         elif flag != _FLAG_RAW:
             raise ChannelError(

@@ -221,7 +221,11 @@ class AzureBlobStore:
             raise AzureError(st, body)
 
     def list(self, prefix: str = "") -> Iterator[str]:
-        full = "/".join(p for p in (self.prefix, prefix) if p)
+        # Always keep the "/" after a store prefix (the S3 backend's
+        # form): joining without it makes list("") match sibling
+        # containers of the prefix and mis-strip their keys.
+        full = f"{self.prefix}/{prefix}" if self.prefix else prefix
+        strip = len(self.prefix) + 1 if self.prefix else 0
         marker = ""
         while True:
             query = {"restype": "container", "comp": "list"}
@@ -235,10 +239,7 @@ class AzureBlobStore:
                 raise AzureError(st, body)
             root = ET.fromstring(body)
             for name in root.iter("Name"):
-                key = name.text or ""
-                if self.prefix:
-                    key = key[len(self.prefix) + 1:]
-                yield key
+                yield (name.text or "")[strip:]
             marker = (root.findtext("NextMarker") or "").strip()
             if not marker:
                 return

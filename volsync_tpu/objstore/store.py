@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from pathlib import Path
 from typing import Iterator, Optional, Protocol
 
@@ -211,6 +212,66 @@ class MemObjectStore:
 
     def size(self, key: str) -> int:
         return len(self.get(key))
+
+
+class LatencyStore:
+    """Wrap any ObjectStore with synthetic per-op latency (seconds) —
+    the fake-cloud backend for pipeline benchmarks and backpressure
+    tests (a MemObjectStore put is ~1 µs; a real store put is tens of
+    ms, which is the regime the async upload stage exists for). Also
+    counts ops and tracks the high-water mark of concurrent puts so
+    tests can assert the upload window is honored."""
+
+    def __init__(self, inner: ObjectStore, *, put_latency: float = 0.0,
+                 get_latency: float = 0.0):
+        self.inner = inner
+        self.put_latency = put_latency
+        self.get_latency = get_latency
+        self.puts = 0
+        self.max_concurrent_puts = 0
+        self._active_puts = 0
+        self._lock = threading.Lock()
+
+    def put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self.puts += 1
+            self._active_puts += 1
+            self.max_concurrent_puts = max(self.max_concurrent_puts,
+                                           self._active_puts)
+        try:
+            if self.put_latency:
+                time.sleep(self.put_latency)
+            self.inner.put(key, data)
+        finally:
+            with self._lock:
+                self._active_puts -= 1
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        if self.put_latency:
+            time.sleep(self.put_latency)
+        return self.inner.put_if_absent(key, data)
+
+    def get(self, key: str) -> bytes:
+        if self.get_latency:
+            time.sleep(self.get_latency)
+        return self.inner.get(key)
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        if self.get_latency:
+            time.sleep(self.get_latency)
+        return self.inner.get_range(key, offset, length)
+
+    def exists(self, key: str) -> bool:
+        return self.inner.exists(key)
+
+    def delete(self, key: str) -> None:
+        self.inner.delete(key)
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        return self.inner.list(prefix)
+
+    def size(self, key: str) -> int:
+        return self.inner.size(key)
 
 
 def open_store(url: str, env: Optional[dict] = None) -> ObjectStore:

@@ -131,10 +131,29 @@ class SwiftObjectStore:
         self._storage_url = storage_url.rstrip("/")
         self._token = auth_token
 
+    #: Keystone credential families restic accepts but this backend's
+    #: built-in v3 client does not implement. Named explicitly in the
+    #: from_url error so an operator whose Secret uses application
+    #: credentials is not told "OS_USERNAME missing".
+    UNSUPPORTED_AUTH_KEYS = (
+        "OS_APPLICATION_CREDENTIAL_ID",
+        "OS_APPLICATION_CREDENTIAL_NAME",
+        "OS_APPLICATION_CREDENTIAL_SECRET",
+        "OS_USER_ID",
+        "OS_TENANT_ID",
+        "OS_PROJECT_ID",
+        "OS_USER_DOMAIN_ID",
+        "OS_PROJECT_DOMAIN_ID",
+        "OS_TRUST_ID",
+    )
+
     @classmethod
     def from_url(cls, url: str, env: dict) -> "SwiftObjectStore":
         """``swift:container:/path`` (restic's URL form) with the OS_* /
-        ST_* env families (restic/mover.go:331-363 passthrough)."""
+        ST_* env families (restic/mover.go:331-363 passthrough).
+        ``swift-temp:`` is accepted as an alias of ``swift:`` — it is a
+        volsync-tpu convenience for temp-auth deployments, NOT a restic
+        location scheme."""
         scheme = "swift-temp" if url.startswith("swift-temp:") else "swift"
         rest = url[len(scheme) + 1:]
         container, _, prefix = rest.partition(":")
@@ -158,6 +177,20 @@ class SwiftObjectStore:
                                    "OS_PROJECT_NAME")
                        if not env.get(k, "")]
             if missing:
+                unsupported = [k for k in cls.UNSUPPORTED_AUTH_KEYS
+                               if env.get(k, "")]
+                if unsupported:
+                    raise ValueError(
+                        "swift: the repository Secret uses Keystone "
+                        "credential keys this backend does not support: "
+                        f"{', '.join(unsupported)}. Only v3 "
+                        "username/password auth (OS_AUTH_URL + OS_USERNAME "
+                        "+ OS_PASSWORD + OS_PROJECT_NAME), v1 auth "
+                        "(ST_AUTH + ST_USER + ST_KEY), or a "
+                        "pre-authenticated OS_STORAGE_URL + OS_AUTH_TOKEN "
+                        "pair are implemented — application credentials, "
+                        "id-based scoping, and trusts are not (see "
+                        "docs/usage/restic.md)")
                 raise ValueError(
                     f"swift: OS_AUTH_URL is set but {', '.join(missing)} "
                     f"{'is' if len(missing) == 1 else 'are'} missing "
@@ -365,7 +398,12 @@ class SwiftObjectStore:
             raise SwiftError(st, body)
 
     def list(self, prefix: str = "") -> Iterator[str]:
-        full = "/".join(p for p in (self.prefix, prefix) if p)
+        # Always keep the "/" after a store prefix (the S3 backend's
+        # form): joining without it makes list("") match sibling
+        # containers of the prefix ("repo" bleeding "repo-other/...")
+        # and mis-strip their keys by prefix-length+1.
+        full = f"{self.prefix}/{prefix}" if self.prefix else prefix
+        strip = len(self.prefix) + 1 if self.prefix else 0
         marker = ""
         while True:
             qs = "format=plain"
@@ -383,8 +421,5 @@ class SwiftObjectStore:
             if not names:
                 return
             for name in names:
-                key = name
-                if self.prefix:
-                    key = key[len(self.prefix) + 1:]
-                yield key
+                yield name[strip:]
             marker = names[-1]

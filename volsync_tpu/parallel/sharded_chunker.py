@@ -297,7 +297,7 @@ def _build_fused_fn(mesh, params: GearParams, shard_len: int,
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from volsync_tpu.parallel.engine import _axis_size, shard_map
 
     from volsync_tpu.ops.gearcdc import gear_at_aligned
     from volsync_tpu.ops.segment import (
@@ -415,7 +415,7 @@ def _build_cand_fn(mesh, params: GearParams, shard_len: int, cap: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from volsync_tpu.parallel.engine import _axis_size, shard_map
 
     from volsync_tpu.parallel.engine import _gear_doubling
 
@@ -424,7 +424,7 @@ def _build_cand_fn(mesh, params: GearParams, shard_len: int, cap: int):
     mask_l = np.uint32(params.mask_l)
 
     def local(data, valid_len):  # data: [1, Ls] this shard's slice
-        n = jax.lax.axis_size(SEQ)
+        n = _axis_size(SEQ)
         i = jax.lax.axis_index(SEQ)
         row = data[0]
         # Left halo: previous shard's 31-byte tail, shifted right around
@@ -464,7 +464,7 @@ def _build_cand_aligned_fn(mesh, params: GearParams, shard_len: int,
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from volsync_tpu.parallel.engine import _axis_size, shard_map
 
     from volsync_tpu.ops.gearcdc import gear_at_aligned
 
@@ -500,14 +500,14 @@ def _build_leaf_fn(mesh, shard_len: int, cap: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from volsync_tpu.parallel.engine import _axis_size, shard_map
 
     from volsync_tpu.ops.sha256 import sha256_chunks_device
 
     assert shard_len >= _LEAF, "shards must cover at least one leaf"
 
     def local(data, starts, lengths):  # [1, Ls], [1, cap], [1, cap]
-        n = jax.lax.axis_size(SEQ)
+        n = _axis_size(SEQ)
         row = data[0]
         # Right halo: my leaves may run up to LEAF-1 bytes past my slice;
         # fetch the next shard's head (ring: the last shard's wrap-around

@@ -6,6 +6,8 @@ This clean-room equivalent keeps the same *security envelope* —
 per-object random nonce, encrypt-then-MAC, password-derived master key —
 using the primitives available in this image's ``cryptography`` wheel
 (HMAC-SHA256 instead of Poly1305; scrypt for key derivation, as restic).
+When that wheel is absent the cipher falls back to a SHAKE-256
+keystream (see ``_xor_stream``); the MAC and KDF are stdlib either way.
 """
 
 from __future__ import annotations
@@ -15,10 +17,33 @@ import hmac as hmac_mod
 import os
 from typing import Optional
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+except ImportError:  # optional binary wheel
+    Cipher = None
+
+HAVE_AES = Cipher is not None
 
 _NONCE = 16  # AES block / CTR nonce size
 _MAC = 32    # HMAC-SHA256
+
+
+def _xor_stream(key: bytes, nonce: bytes, data: bytes) -> bytes:
+    """Stdlib stream cipher for builds without the ``cryptography`` wheel.
+
+    XOR against a SHAKE-256(key ‖ nonce) keystream — same envelope
+    (random nonce, encrypt-then-MAC) but NOT wire-compatible with the
+    AES-CTR build: an object sealed by one cipher opens to garbage on
+    the other, which the downstream decompression/JSON layer rejects.
+    The MAC (shared scheme) still authenticates either way.
+    """
+    if not data:
+        return b""
+    ks = hashlib.shake_256(key + nonce).digest(len(data))
+    n = len(data)
+    return (int.from_bytes(data, "little")
+            ^ int.from_bytes(ks, "little")).to_bytes(n, "little")
 
 
 class IntegrityError(ValueError):
@@ -39,8 +64,12 @@ class SecretBox:
 
     def seal(self, plaintext: bytes) -> bytes:
         nonce = os.urandom(_NONCE)
-        enc = Cipher(algorithms.AES(self.enc_key), modes.CTR(nonce)).encryptor()
-        ct = enc.update(plaintext) + enc.finalize()
+        if Cipher is not None:
+            enc = Cipher(algorithms.AES(self.enc_key),
+                         modes.CTR(nonce)).encryptor()
+            ct = enc.update(plaintext) + enc.finalize()
+        else:
+            ct = _xor_stream(self.enc_key, nonce, plaintext)
         mac = hmac_mod.new(self.mac_key, nonce + ct, hashlib.sha256).digest()
         return nonce + ct + mac
 
@@ -52,8 +81,11 @@ class SecretBox:
         want = hmac_mod.new(self.mac_key, nonce + ct, hashlib.sha256).digest()
         if not hmac_mod.compare_digest(mac, want):
             raise IntegrityError("MAC mismatch (corrupt or tampered object)")
-        dec = Cipher(algorithms.AES(self.enc_key), modes.CTR(nonce)).decryptor()
-        return dec.update(ct) + dec.finalize()
+        if Cipher is not None:
+            dec = Cipher(algorithms.AES(self.enc_key),
+                         modes.CTR(nonce)).decryptor()
+            return dec.update(ct) + dec.finalize()
+        return _xor_stream(self.enc_key, nonce, ct)
 
     @property
     def overhead(self) -> int:

@@ -26,11 +26,15 @@ from dataclasses import dataclass, field
 from datetime import datetime, timedelta, timezone
 from typing import Iterable, Optional
 
-import zstandard
+from concurrent.futures import Future, ThreadPoolExecutor
 
+from volsync_tpu import envflags
+from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
 from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
+from volsync_tpu.obs import span
 from volsync_tpu.repo import blobid, crypto
 from volsync_tpu.repo.compactindex import CompactIndex
+from volsync_tpu.repo.compress import Compressor, Decompressor
 
 BLOB_DATA = "data"
 BLOB_TREE = "tree"
@@ -54,6 +58,61 @@ class RepoError(RuntimeError):
 
 class RepoLockedError(RepoError):
     """Another process holds a conflicting repository lock."""
+
+
+class UploadError(RepoError):
+    """A pack upload failed after retries; the pack was NOT registered,
+    so no index entry references it."""
+
+
+# Shared worker pools for the pipelined write path — module-level
+# singletons so a process that opens many Repository objects (tests,
+# multi-CR movers) does not leak a thread pool per repo. Per-repo
+# backpressure (seal queue limit, upload window) still bounds each
+# repository's in-flight work; the pools just supply the threads.
+_pools_lock = threading.Lock()
+_seal_pool: Optional[ThreadPoolExecutor] = None
+_upload_pool: Optional[ThreadPoolExecutor] = None
+
+
+def _get_seal_pool() -> ThreadPoolExecutor:
+    global _seal_pool
+    with _pools_lock:
+        if _seal_pool is None:
+            _seal_pool = ThreadPoolExecutor(
+                max_workers=envflags.seal_workers(),
+                thread_name_prefix="vtpk-seal")
+        return _seal_pool
+
+
+def _get_upload_pool() -> ThreadPoolExecutor:
+    global _upload_pool
+    with _pools_lock:
+        if _upload_pool is None:
+            _upload_pool = ThreadPoolExecutor(
+                max_workers=max(4, envflags.upload_window()),
+                thread_name_prefix="vtpk-upload")
+        return _upload_pool
+
+
+@dataclass
+class _OpenBlob:
+    """A blob admitted to the open pack whose sealed form is still being
+    produced by the seal pool."""
+    meta: dict            # {"id", "type", "raw_length"}
+    fut: Future           # resolves to the sealed segment bytes
+    stats: Optional["BackupStats"]
+
+
+@dataclass
+class _InflightPack:
+    """A closed pack whose upload is in flight. ``entries``/``body`` are
+    retained until the reap so buffered reads and a mid-run load_index
+    can still see its blobs (they stay pack="" in the index until the
+    put completes)."""
+    entries: list[dict]
+    body: bytes
+    fut: Future           # resolves to (pack_id, pack_bytes_len)
 
 
 def _parse_time(value: str) -> datetime:
@@ -107,12 +166,25 @@ class Repository:
         self._cur_size = 0
         self._pending_index: dict[str, list[dict]] = {}
         self._pending_count = 0
-        self._zc = zstandard.ZstdCompressor(level=3)
-        # Decompression runs OUTSIDE self._lock on the concurrent
-        # restore/verify paths (read_blob from worker pools), and a
-        # ZstdDecompressor shares one ZSTD_DCtx that python-zstandard
-        # documents as not thread-safe — so it's thread-local.
-        self._zd_local = threading.local()
+        # Compression contexts are NOT thread-safe (one ZSTD_CCtx/DCtx
+        # each) and run off-lock on the pipelined seal workers and the
+        # concurrent restore/verify readers — both are thread-local.
+        self._z_local = threading.local()
+        # -- pipelined write path (VOLSYNC_TPU_PIPELINE, default on) --
+        # Stage queues, all mutated only under self._lock by caller
+        # threads; pool workers never touch repo state or self._lock
+        # (prune calls flush() while holding it — a worker that locked
+        # would deadlock the barrier).
+        self.pipelined = envflags.pipeline_enabled()
+        self._pl_open: list[_OpenBlob] = []       # seal stage queue
+        self._pl_inflight: list[_InflightPack] = []  # upload stage queue
+        self._pl_seal_limit = envflags.seal_queue_limit()
+        self._pl_upload_slots = threading.BoundedSemaphore(
+            envflags.upload_window())
+        self._pl_retries = envflags.upload_retries()
+        self._pl_error: Optional[Exception] = None
+        self._g_seal = GLOBAL_METRICS.pipeline_depth.labels(stage="seal")
+        self._g_upload = GLOBAL_METRICS.pipeline_depth.labels(stage="upload")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -340,6 +412,18 @@ class Repository:
                 self._index.insert(
                     e["id"], "", e["type"], e["offset"], e["length"],
                     e["raw_length"], replace=False)
+            # Pipelined in-flight state: blobs queued for sealing and
+            # packs whose upload has not been reaped stay visible (and
+            # dedup-able) as pack="" entries across a reload.
+            for pk in self._pl_inflight:
+                for e in pk.entries:
+                    self._index.insert(
+                        e["id"], "", e["type"], e["offset"], e["length"],
+                        e["raw_length"], replace=False)
+            for ob in self._pl_open:
+                self._index.insert(
+                    ob.meta["id"], "", ob.meta["type"], 0, 0,
+                    ob.meta["raw_length"], replace=False)
 
     def has_blob(self, blob_id: str) -> bool:
         with self._lock:
@@ -360,16 +444,24 @@ class Repository:
     # -- write path ---------------------------------------------------------
 
     def _encode_blob(self, data: bytes) -> bytes:
-        comp = self._zc.compress(data)
-        if len(comp) <= len(data) * _COMPRESS_MIN_GAIN:
-            return self.box.seal(b"\x01" + comp)
-        return self.box.seal(b"\x00" + data)
+        with span("repo.seal"):
+            comp = self._zc.compress(data)
+            if len(comp) <= len(data) * _COMPRESS_MIN_GAIN:
+                return self.box.seal(b"\x01" + comp)
+            return self.box.seal(b"\x00" + data)
+
+    @property
+    def _zc(self):
+        zc = getattr(self._z_local, "zc", None)
+        if zc is None:
+            zc = self._z_local.zc = Compressor(level=3)
+        return zc
 
     @property
     def _zd(self):
-        zd = getattr(self._zd_local, "zd", None)
+        zd = getattr(self._z_local, "zd", None)
         if zd is None:
-            zd = self._zd_local.zd = zstandard.ZstdDecompressor()
+            zd = self._z_local.zd = Decompressor()
         return zd
 
     def _decode_blob(self, sealed: bytes) -> bytes:
@@ -380,13 +472,41 @@ class Repository:
 
     def add_blob(self, btype: str, blob_id: str, data: bytes,
                  stats: Optional[BackupStats] = None) -> bool:
-        """Store a blob unless present. Returns True if newly stored."""
+        """Store a blob unless present. Returns True if newly stored.
+
+        Pipelined mode (VOLSYNC_TPU_PIPELINE, default on) hands the
+        zstd+AES sealing to a worker pool and returns once the blob is
+        queued; pack close and upload happen as sealed segments drain.
+        A prior upload failure surfaces here (before flush) as
+        UploadError."""
         with self._lock:
             if blob_id in self._index:
                 if stats:
                     stats.blobs_dedup += 1
                     stats.bytes_dedup += len(data)
                 return False
+            if self.pipelined:
+                self._pl_raise()
+                fut = _get_seal_pool().submit(self._encode_blob, data)
+                self._pl_open.append(_OpenBlob(
+                    meta={"id": blob_id, "type": btype,
+                          "raw_length": len(data)},
+                    fut=fut, stats=stats))
+                self._g_seal.set(len(self._pl_open))
+                # visible to dedup immediately; real offset/length land
+                # when the sealed segment drains into the open pack
+                self._index.insert(blob_id, "", btype, 0, 0, len(data))
+                if stats:
+                    stats.blobs_new += 1
+                    stats.bytes_new += len(data)
+                self._pl_drain(block=False)
+                while len(self._pl_open) >= self._pl_seal_limit:
+                    # backpressure: bound raw+sealed bytes held by the
+                    # seal queue by blocking on the head future (workers
+                    # never need self._lock, so this cannot deadlock)
+                    self._pl_drain_one()
+                self._pl_reap(block=False)
+                return True
             seg = self._encode_blob(data)
             self._cur_entries.append({
                 "id": blob_id, "type": btype, "offset": self._cur_size,
@@ -406,7 +526,141 @@ class Repository:
                 self._flush_pack()
             return True
 
+    # -- pipelined write path ------------------------------------------------
+    #
+    # Four stages run concurrently with backpressure: read-ahead
+    # (engine/chunker._ReadaheadReader), device chunk+hash (unchanged),
+    # async sealing (seal pool), async upload (upload pool, bounded
+    # in-flight window). All repository state is mutated only by caller
+    # threads under self._lock; pool workers seal/hash/put and nothing
+    # else, so flush()/prune() can hold the lock across the barrier.
+    # Byte-identity with the serial path is structural: segments drain in
+    # submit order, pack boundaries use the same cumulative-sealed-size
+    # rule at the same positions, headers are the same JSON of the same
+    # entry dicts, and packs register (and index deltas persist) in pack
+    # creation order.
+
+    def _pl_drain_one(self):
+        """Resolve the head of the seal queue into the open pack; close
+        the pack when the sealed size crosses PACK_TARGET."""
+        ob = self._pl_open.pop(0)
+        seg = ob.fut.result()
+        self._cur_entries.append({
+            "id": ob.meta["id"], "type": ob.meta["type"],
+            "offset": self._cur_size, "length": len(seg),
+            "raw_length": ob.meta["raw_length"],
+        })
+        self._cur_segments.append(seg)
+        self._cur_size += len(seg)
+        self._index.insert(ob.meta["id"], "", ob.meta["type"],
+                           self._cur_entries[-1]["offset"], len(seg),
+                           ob.meta["raw_length"])
+        if ob.stats:
+            ob.stats.bytes_stored += len(seg)
+        self._g_seal.set(len(self._pl_open))
+        if self._cur_size >= self.PACK_TARGET:
+            self._pl_close_pack()
+
+    def _pl_drain(self, block: bool):
+        while self._pl_open and (block or self._pl_open[0].fut.done()):
+            self._pl_drain_one()
+
+    def _pl_close_pack(self):
+        """Hand the open pack to the upload stage. Blocks while the
+        in-flight window (VOLSYNC_TPU_UPLOAD_WINDOW) is full — that
+        bounds sealed pack bytes held in memory."""
+        if not self._cur_segments:
+            return
+        body = b"".join(self._cur_segments)
+        entries = self._cur_entries
+        self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
+        self._pl_upload_slots.acquire()
+        fut = _get_upload_pool().submit(self._upload_pack, body, entries)
+        self._pl_inflight.append(
+            _InflightPack(entries=entries, body=body, fut=fut))
+        self._g_upload.set(len(self._pl_inflight))
+        self._pl_reap(block=False)
+
+    def _upload_pack(self, body: bytes, entries: list[dict]) -> str:
+        """Upload worker: seal the header, hash the pack, put with
+        retry/backoff. Runs on the upload pool; touches no repository
+        state and never takes self._lock."""
+        try:
+            header = self.box.seal(
+                self._zc.compress(json.dumps(entries).encode()))
+            blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
+            pack_id = hashlib.sha256(blob).hexdigest()
+            with span("repo.pack_upload"):
+                delay = 0.05
+                for attempt in range(self._pl_retries + 1):
+                    try:
+                        self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
+                        break
+                    except Exception:
+                        if attempt == self._pl_retries:
+                            raise
+                        time_mod.sleep(delay)
+                        delay *= 2
+            return pack_id
+        finally:
+            self._pl_upload_slots.release()
+
+    def _pl_reap(self, block: bool):
+        """Register completed uploads in FIFO (pack creation) order:
+        bind index entries to the now-durable pack, buffer its index
+        delta, persist deltas at the limit — the same delta grouping as
+        the serial path. A failed upload records the error and registers
+        NOTHING, so no persisted index object can reference its pack."""
+        while (self._pl_inflight
+               and (block or self._pl_inflight[0].fut.done())):
+            pk = self._pl_inflight.pop(0)
+            try:
+                pack_id = pk.fut.result()
+            except Exception as ex:  # noqa: BLE001 — surfaced via _pl_raise
+                if self._pl_error is None:
+                    self._pl_error = ex
+                continue
+            for e in pk.entries:
+                cur = self._index.lookup(e["id"])
+                if cur is None or cur[0] == "":
+                    self._index.insert(e["id"], pack_id, e["type"],
+                                       e["offset"], e["length"],
+                                       e["raw_length"])
+            self._pending_index[pack_id] = pk.entries
+            self._pending_count += len(pk.entries)
+            if self._pending_count >= self.PENDING_INDEX_LIMIT:
+                self._persist_pending()
+        self._g_upload.set(len(self._pl_inflight))
+
+    def _pl_raise(self):
+        if self._pl_error is not None:
+            err, self._pl_error = self._pl_error, None
+            raise UploadError(f"pack upload failed: {err}") from err
+
+    def _find_buffered(self, blob_id: str) -> Optional[bytes]:
+        """Sealed segment for a pack="" blob, wherever the pipeline
+        holds it: the drained open pack, the seal queue (blocks on that
+        blob's future), or an in-flight pack's body."""
+        for e, seg in zip(self._cur_entries, self._cur_segments):
+            if e["id"] == blob_id:
+                return seg
+        for ob in self._pl_open:
+            if ob.meta["id"] == blob_id:
+                return ob.fut.result()
+        for pk in self._pl_inflight:
+            for e in pk.entries:
+                if e["id"] == blob_id:
+                    return pk.body[e["offset"]:e["offset"] + e["length"]]
+        return None
+
     def _flush_pack(self):
+        if self.pipelined:
+            # explicit pack boundary (prune's rewrite packs, tests):
+            # everything queued behind the seal stage belongs to this
+            # pack, so drain it into the open pack, then close async
+            self._pl_drain(block=True)
+            self._pl_close_pack()
+            return
         if not self._cur_segments:
             return
         body = b"".join(self._cur_segments)
@@ -415,7 +669,8 @@ class Repository:
         )
         blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
         pack_id = hashlib.sha256(blob).hexdigest()
-        self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
+        with span("repo.pack_upload"):
+            self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
         for e in self._cur_entries:
             cur = self._index.lookup(e["id"])
             if cur is None or cur[0] == "":
@@ -443,10 +698,29 @@ class Repository:
         self._pending_index = {}
         self._pending_count = 0
 
-    def flush(self):
-        """Flush the open pack and persist an index delta."""
-        with self._lock:
+    def _flush_data(self):
+        """Barrier: every buffered blob sealed, packed, and durably in
+        the store (no index persist). Pipelined mode drains the seal
+        queue, closes the tail pack, and joins every in-flight upload;
+        the serial fallback flushes inline."""
+        if not self.pipelined:
             self._flush_pack()
+            return
+        self._pl_drain(block=True)
+        self._pl_close_pack()
+        with span("repo.upload_wait"):
+            self._pl_reap(block=True)
+        self._pl_raise()
+
+    def flush(self):
+        """Flush all buffered data and persist an index delta.
+
+        This is the durability barrier the snapshot write relies on: in
+        pipelined mode it joins every in-flight upload BEFORE the index
+        delta referencing those packs is written, and re-raises the
+        first upload failure (whose pack was never registered)."""
+        with self._lock:
+            self._flush_data()
             self._persist_pending()
 
     # -- read path ----------------------------------------------------------
@@ -456,11 +730,11 @@ class Repository:
             entry = self._entry(blob_id)
             if entry is None:
                 raise RepoError(f"blob {blob_id} not in index")
-            if entry.pack == "":  # still buffered in the open pack
-                for e, seg in zip(self._cur_entries, self._cur_segments):
-                    if e["id"] == blob_id:
-                        return self._decode_blob(seg)
-                raise RepoError(f"blob {blob_id} buffered but missing")
+            if entry.pack == "":  # still buffered in the write pipeline
+                seg = self._find_buffered(blob_id)
+                if seg is None:
+                    raise RepoError(f"blob {blob_id} buffered but missing")
+                return self._decode_blob(seg)
         return self._read_packed(blob_id, entry)
 
     def read_blob_raw(self, blob_id: str) -> bytes:
@@ -472,11 +746,11 @@ class Repository:
             entry = self._entry(blob_id)
             if entry is None:
                 raise RepoError(f"blob {blob_id} not in index")
-            if entry.pack == "":  # still buffered in the open pack
-                for e, seg in zip(self._cur_entries, self._cur_segments):
-                    if e["id"] == blob_id:
-                        return self._decode_blob(seg)
-                raise RepoError(f"blob {blob_id} buffered but missing")
+            if entry.pack == "":  # still buffered in the write pipeline
+                seg = self._find_buffered(blob_id)
+                if seg is None:
+                    raise RepoError(f"blob {blob_id} buffered but missing")
+                return self._decode_blob(seg)
         return self._read_packed(blob_id, entry, verify=False)
 
     def _read_packed(self, blob_id: str, entry: IndexEntry, *,
@@ -708,7 +982,7 @@ class Repository:
             for blob_id in doomed:
                 self._index.remove(blob_id)
                 removed_blobs += 1
-            self._flush_pack()  # step 1 durable before anything is deleted
+            self._flush_data()  # step 1 durable before anything is deleted
             self._index.vacuum()
             # Step 2: consolidated index, SHARDED into bounded delta
             # objects (~PENDING_INDEX_LIMIT entries each) so no single
