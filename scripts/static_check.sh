@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Local static-analysis + concurrency gate (docs/development.md).
+#
+#   1. `volsync lint` over the shipped package — must be clean with no
+#      baseline (tests/test_analysis.py enforces the same in tier-1).
+#   2. The pipeline + crash-recovery suites with the lock-order/race
+#      detector armed at process start (VOLSYNC_TPU_LOCKCHECK=1), so
+#      module-level locks are instrumented too.
+#
+# Run from the repo root before pushing data-plane changes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== volsync lint =="
+python -m volsync_tpu.analysis volsync_tpu/ --no-baseline
+
+echo "== lockcheck-armed pipeline suites =="
+JAX_PLATFORMS=cpu VOLSYNC_TPU_LOCKCHECK=1 \
+    python -m pytest tests/test_lockcheck.py tests/test_pipeline.py \
+        tests/test_crash_recovery.py -q -p no:cacheprovider
+
+echo "static_check: OK"

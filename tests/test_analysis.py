@@ -1,0 +1,239 @@
+"""The analyzer analyzed: seeded-violation fixtures per rule, baseline
+add/expire, suppression comments, and the tier-1 gate — `volsync lint`
+runs clean over the shipped package with NO baseline."""
+
+from pathlib import Path
+
+import volsync_tpu
+from volsync_tpu.analysis import (
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from volsync_tpu.analysis.cli import main as lint_main
+from volsync_tpu.cli.main import run as cli_run
+
+
+def _lint_file(tmp_path, source, name="mod.py", subdir=None):
+    d = tmp_path if subdir is None else tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(source)
+    findings, errors = run_lint([str(f)])
+    assert errors == []
+    return findings
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# -- rule fixtures ----------------------------------------------------------
+
+def test_vl001_env_read_flagged(tmp_path):
+    src = (
+        "import os\n"
+        "import os as _os\n"
+        "from os import environ, getenv as ge\n"
+        "a = os.environ.get('VOLSYNC_FOO')\n"
+        "b = _os.environ['VOLSYNC_BAR']\n"
+        "c = environ.get('VOLSYNC_BAZ')\n"
+        "d = ge('VOLSYNC_QUX')\n"
+        "e = 'VOLSYNC_IN' in os.environ\n"
+        "ok1 = os.environ.get('HOME')\n"          # not VOLSYNC_*
+        "ok2 = os.environ.get(a)\n"               # non-literal key
+        "os.environ['VOLSYNC_SET'] = '1'\n"       # write, not read
+    )
+    findings = _lint_file(tmp_path, src)
+    assert _codes(findings) == ["VL001"] * 5
+    assert {f.line for f in findings} == {4, 5, 6, 7, 8}
+
+
+def test_vl001_envflags_exempt(tmp_path):
+    src = "import os\nx = os.environ.get('VOLSYNC_FOO')\n"
+    findings = _lint_file(tmp_path, src, name="envflags.py")
+    assert findings == []
+
+
+def test_vl002_gated_imports(tmp_path):
+    src = ("import zstandard\n"
+           "from cryptography.hazmat.primitives import hashes\n"
+           "import json\n")
+    findings = _lint_file(tmp_path, src)
+    assert _codes(findings) == ["VL002", "VL002"]
+    # ...but fine inside the shims
+    assert _lint_file(tmp_path, "import zstandard\n",
+                      name="compress.py", subdir="repo") == []
+    assert _lint_file(tmp_path, "import cryptography\n",
+                      name="crypto.py", subdir="repo") == []
+
+
+def test_vl003_silent_swallow(tmp_path):
+    src = (
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+        "try:\n    x = 2\nexcept:\n    pass\n"
+        "for i in range(3):\n"
+        "    try:\n        x = 3\n    except BaseException:\n"
+        "        continue\n"
+        # narrow type: allowed
+        "try:\n    x = 4\nexcept ValueError:\n    pass\n"
+        # broad but logged: allowed
+        "try:\n    x = 5\nexcept Exception as e:\n    print(e)\n"
+        # broad but re-raised: allowed
+        "try:\n    x = 6\nexcept Exception:\n    raise\n"
+    )
+    findings = _lint_file(tmp_path, src)
+    assert _codes(findings) == ["VL003"] * 3
+
+
+def test_vl003_suppression_comment(tmp_path):
+    src = ("try:\n    x = 1\n"
+           "except Exception:  # lint: ignore[VL003] — reason here\n"
+           "    pass\n"
+           "try:\n    x = 2\n"
+           "except Exception:  # lint: ignore\n"
+           "    pass\n"
+           "try:\n    x = 3\n"
+           "except Exception:  # lint: ignore[VL001]\n"  # wrong code
+           "    pass\n")
+    findings = _lint_file(tmp_path, src)
+    assert _codes(findings) == ["VL003"]
+    assert findings[0].line == 11
+
+
+def test_vl004_tracer_safety(tmp_path):
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, n):\n"
+        "    if x > 0:\n"            # VL004: branch on traced arg
+        "        return float(x)\n"  # VL004: float() on traced
+        "    if n > 2:\n"            # static arg: allowed
+        "        return x.item()\n"  # VL004: .item()
+        "    if x.shape[0] == 1:\n"  # shape access: static, allowed
+        "        return x\n"
+        "    if x is None:\n"        # identity check: allowed
+        "        return x\n"
+        "    return x\n"
+        "def host(x):\n"
+        "    return float(x)\n"      # not jit'd: allowed
+    )
+    findings = _lint_file(tmp_path, src, subdir="ops")
+    assert _codes(findings) == ["VL004"] * 3
+    assert {f.line for f in findings} == {5, 6, 8}
+    # same file OUTSIDE an ops/ dir: rule out of scope
+    assert _lint_file(tmp_path, src, subdir="host") == []
+
+
+def test_vl005_direct_lock(tmp_path):
+    src = ("import threading\n"
+           "from threading import Lock\n"
+           "a = threading.Lock()\n"
+           "b = threading.RLock()\n"
+           "c = Lock()\n"
+           "e = threading.Event()\n")  # not a lock: allowed
+    findings = _lint_file(tmp_path, src, subdir="repo")
+    assert _codes(findings) == ["VL005"] * 3
+    # out of data-plane scope: allowed
+    assert _lint_file(tmp_path, src, subdir="cluster") == []
+
+
+def test_syntax_error_is_reported(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text("def broken(:\n")
+    findings, errors = run_lint([str(f)])
+    assert findings == []
+    assert len(errors) == 1 and "bad.py" in errors[0]
+
+
+# -- baseline add / expire --------------------------------------------------
+
+def test_baseline_roundtrip_and_expiry(tmp_path):
+    mod = tmp_path / "legacy.py"
+    mod.write_text("import os\n"
+                   "a = os.environ.get('VOLSYNC_OLD')\n"
+                   "b = os.environ.get('VOLSYNC_OLDER')\n")
+    baseline_path = tmp_path / "baseline.json"
+
+    findings, _ = run_lint([str(mod)])
+    assert len(findings) == 2
+    write_baseline(findings, baseline_path)
+
+    # grandfathered: nothing new
+    baseline = load_baseline(baseline_path)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    assert new == [] and suppressed == 2 and stale == []
+
+    # a NEW violation is not covered by the old allowance
+    mod.write_text(mod.read_text()
+                   + "c = os.environ.get('VOLSYNC_NEW')\n")
+    findings2, _ = run_lint([str(mod)])
+    new, suppressed, stale = apply_baseline(findings2,
+                                            load_baseline(baseline_path))
+    assert len(new) == 1 and "VOLSYNC_NEW" in new[0].message
+    assert suppressed == 2
+
+    # fixing a grandfathered finding EXPIRES its baseline entry
+    mod.write_text("import os\n"
+                   "a = os.environ.get('VOLSYNC_OLD')\n")
+    findings3, _ = run_lint([str(mod)])
+    new, suppressed, stale = apply_baseline(findings3,
+                                            load_baseline(baseline_path))
+    assert new == [] and suppressed == 1
+    assert len(stale) == 1 and "VOLSYNC_OLDER" in stale[0]
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    assert load_baseline(tmp_path / "nope.json") == {}
+
+
+def test_cli_exit_codes_and_write_baseline(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("import os\nx = os.environ.get('VOLSYNC_X')\n")
+    baseline = tmp_path / "b.json"
+    lines = []
+
+    rc = lint_main([str(mod), "--baseline", str(baseline)],
+                   out=lines.append)
+    assert rc == 1
+    assert any("VL001" in ln for ln in lines)
+
+    rc = lint_main([str(mod), "--baseline", str(baseline),
+                    "--write-baseline"], out=lines.append)
+    assert rc == 0 and baseline.exists()
+
+    rc = lint_main([str(mod), "--baseline", str(baseline)],
+                   out=lines.append)
+    assert rc == 0
+
+    # --no-baseline reports everything again
+    rc = lint_main([str(mod), "--baseline", str(baseline),
+                    "--no-baseline"], out=lines.append)
+    assert rc == 1
+
+
+def test_volsync_cli_lint_verb(tmp_path):
+    """`volsync lint` dispatches to the analyzer without needing any
+    cluster context."""
+    mod = tmp_path / "m.py"
+    mod.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    lines = []
+    rc = cli_run(["lint", str(mod), "--no-baseline"], {},
+                 out=lines.append)
+    assert rc == 1
+    assert any("VL003" in ln for ln in lines)
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+def test_package_is_lint_clean():
+    """The whole shipped package passes every rule with NO baseline:
+    the repo's stated invariants (env reads via envflags, gated
+    imports, no silent swallows, tracer-safe kernels, lockcheck-routed
+    locks) hold right now, and this test keeps them held."""
+    pkg = Path(volsync_tpu.__file__).resolve().parent
+    findings, errors = run_lint([str(pkg)])
+    assert errors == []
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)

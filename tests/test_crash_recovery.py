@@ -14,9 +14,20 @@ repo/repository.py (pack -> index -> snapshot).
 import numpy as np
 import pytest
 
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.engine import TreeBackup, restore_snapshot
 from volsync_tpu.objstore.store import FsObjectStore
 from volsync_tpu.repo.repository import Repository
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_armed(monkeypatch):
+    """Crash-recovery paths (retried backups, prune sweeps) run with
+    the lock-order/race detector on — see tests/test_lockcheck.py."""
+    monkeypatch.setenv("VOLSYNC_TPU_LOCKCHECK", "1")
+    lockcheck.reset()
+    yield
+    assert lockcheck.violations() == []
 
 
 class DyingStore:

@@ -17,11 +17,24 @@ import numpy as np
 import pytest
 
 from volsync_tpu import envflags
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
 from volsync_tpu.repo import blobid
 from volsync_tpu.repo.repository import BackupStats, Repository, UploadError
 
 SNAP_TIME = "2026-01-02T03:04:05+00:00"
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_armed(monkeypatch):
+    """The whole pipeline suite runs with the lock-order/race detector
+    on: every Repository/store built in a test gets instrumented locks,
+    and a lock-order cycle or unguarded pipeline-state mutation fails
+    the test even if a worker thread swallowed the raise."""
+    monkeypatch.setenv("VOLSYNC_TPU_LOCKCHECK", "1")
+    lockcheck.reset()
+    yield
+    assert lockcheck.violations() == []
 
 
 def _blobs(n=40, size=3000, seed=5):

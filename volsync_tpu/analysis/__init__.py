@@ -1,0 +1,33 @@
+"""Repo-specific correctness layer: static analysis + runtime checks.
+
+Two halves:
+
+* ``volsync_tpu.analysis.engine`` / ``rules`` — an AST lint pass
+  (``python -m volsync_tpu.analysis``, also ``volsync lint``) enforcing
+  the invariants the code states but Python can't: env knobs parse only
+  through envflags.py, optional heavy deps stay behind their shims,
+  no silent exception swallowing, tracer-unsafe host ops stay out of
+  jit'd kernels, data-plane locks route through lockcheck.
+
+* ``volsync_tpu.analysis.lockcheck`` — a debug-flag
+  (``VOLSYNC_TPU_LOCKCHECK=1``) runtime detector that records the
+  lock-acquisition graph per thread, fails fast on lock-order cycles
+  (potential deadlock), and backs held-lock assertions on the pipeline
+  stages' shared state.
+"""
+
+from volsync_tpu.analysis.engine import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "run_lint",
+    "load_baseline",
+    "apply_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,3 @@
+from volsync_tpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,88 @@
+"""Command-line front end: ``python -m volsync_tpu.analysis`` and the
+``volsync lint`` subcommand both land here.
+
+Exit codes: 0 clean (stale baseline entries only warn), 1 new findings
+or unparsable files, 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional
+
+from volsync_tpu.analysis.engine import (
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = ".volsync-lint-baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="volsync lint",
+        description="Repo-invariant AST lint for volsync-tpu "
+                    "(rules VL001-VL005; see docs/development.md)")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the installed "
+             "volsync_tpu package)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline file of grandfathered findings "
+             f"(default: ./{DEFAULT_BASELINE} when present)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file — report everything")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file and exit 0")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print rule codes/descriptions and exit")
+    return parser
+
+
+def main(argv: Optional[list] = None, out=print) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        from volsync_tpu.analysis.rules import default_rules
+
+        for rule in default_rules():
+            out(f"{rule.code}  {rule.name}: {rule.description}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [str(Path(__file__).resolve().parent.parent)]
+
+    findings, errors = run_lint(paths)
+    for e in errors:
+        out(f"error: {e}")
+
+    baseline_path = Path(args.baseline) if args.baseline else Path(
+        DEFAULT_BASELINE)
+    if args.write_baseline:
+        write_baseline(findings, baseline_path)
+        out(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    for f in new:
+        out(f.render())
+    for k in stale:
+        out(f"stale baseline entry (fixed? regenerate with "
+            f"--write-baseline): {k}")
+    if new or errors:
+        out(f"{len(new)} new finding(s), {suppressed} baselined, "
+            f"{len(errors)} file error(s)")
+        return 1
+    if suppressed or stale:
+        out(f"clean: 0 new finding(s), {suppressed} baselined, "
+            f"{len(stale)} stale baseline entr(y/ies)")
+    return 0

@@ -1,0 +1,185 @@
+"""AST lint engine: file walker, rule runner, baseline, reporting.
+
+The engine is deliberately tiny and dependency-free (stdlib ``ast``
+only): it parses each ``.py`` file once, hands the tree + source lines
+to every registered rule (analysis/rules.py), and post-filters the
+findings through inline suppressions and the checked-in baseline.
+
+Output format is one finding per line, ``file:line CODE message`` —
+greppable, editor-clickable, stable for the baseline diff.
+
+Suppressions
+------------
+A finding on line N is suppressed when line N carries a comment
+``# lint: ignore[CODE]`` (or ``# lint: ignore`` for all codes). The
+suppression is part of the code under review — it shows up in diffs,
+unlike a baseline entry.
+
+Baseline
+--------
+``--write-baseline`` records the current findings keyed by
+``path:CODE:message`` (line numbers excluded, so unrelated edits above
+a grandfathered site don't churn the file) with a count per key.
+Subsequent runs subtract the baseline: only NEW findings fail the run.
+Baseline entries that no longer match anything are reported as stale —
+the expire half of the workflow — so the file shrinks monotonically
+toward empty instead of fossilizing.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # posix, as given/walked — what gets printed
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.code} {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.path}:{self.code}:{self.message}"
+
+
+class FileContext:
+    """Everything a rule may look at for one file."""
+
+    def __init__(self, path: Path, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath  # posix path as reported in findings
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+
+    def scope_dirs(self) -> list[str]:
+        """Directory components AFTER the last ``volsync_tpu`` path
+        element (all of them when absent) — what scope-limited rules
+        match against, so an absolute checkout path like
+        ``/root/repo/...`` can't smuggle components (``repo``!) into
+        the scope decision."""
+        parts = self.relpath.split("/")[:-1]
+        if "volsync_tpu" in parts:
+            parts = parts[len(parts) - parts[::-1].index("volsync_tpu"):]
+        return parts
+
+    def in_module(self, *suffixes: str) -> bool:
+        """True when this file IS one of ``suffixes`` (posix path
+        suffix match on a path-component boundary) — how rules express
+        'allowed only in repo/compress.py'."""
+        for suffix in suffixes:
+            if self.relpath == suffix or self.relpath.endswith("/" + suffix):
+                return True
+        return False
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            yield from sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts)
+        elif p.suffix == ".py":
+            yield p
+
+
+def _suppressed(ctx: FileContext, finding: Finding) -> bool:
+    m = _SUPPRESS_RE.search(ctx.line_text(finding.line))
+    if not m:
+        return False
+    codes = m.group(1)
+    if codes is None:
+        return True
+    return finding.code in {c.strip() for c in codes.split(",")}
+
+
+def run_lint(paths: Iterable[str],
+             rules: Optional[list] = None) -> tuple[list[Finding], list[str]]:
+    """Lint ``paths`` -> (findings, errors). ``errors`` are files that
+    failed to read/parse — reported, and they fail the run (a syntax
+    error must not read as 'clean')."""
+    if rules is None:
+        from volsync_tpu.analysis.rules import default_rules
+
+        rules = default_rules()
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        relpath = path.as_posix()
+        try:
+            source = path.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{relpath}: {e}")
+            continue
+        ctx = FileContext(path, relpath, source, tree)
+        for rule in rules:
+            for f in rule.check(ctx):
+                if not _suppressed(ctx, f):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, errors
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: Path) -> dict[str, int]:
+    """{baseline_key: allowed count}. Missing file -> empty baseline."""
+    try:
+        raw = json.loads(path.read_text(encoding="utf-8"))
+    except FileNotFoundError:
+        return {}
+    counts = raw.get("findings", {})
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def write_baseline(findings: Iterable[Finding], path: Path) -> None:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.baseline_key()] = counts.get(f.baseline_key(), 0) + 1
+    payload = {
+        "comment": ("grandfathered `volsync lint` findings; regenerate "
+                    "with --write-baseline, shrink it whenever you fix "
+                    "one"),
+        "findings": dict(sorted(counts.items())),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def apply_baseline(
+        findings: list[Finding],
+        baseline: dict[str, int]) -> tuple[list[Finding], int, list[str]]:
+    """Split findings against the baseline.
+
+    Returns (new_findings, suppressed_count, stale_keys): findings
+    beyond a key's allowance are new; allowances nothing matched are
+    stale (fixed or moved — time to regenerate the baseline).
+    """
+    remaining = dict(baseline)
+    new: list[Finding] = []
+    suppressed = 0
+    for f in findings:
+        k = f.baseline_key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            suppressed += 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, v in remaining.items() if v > 0)
+    return new, suppressed, stale

@@ -1,0 +1,335 @@
+"""Lint rules enforcing volsync-tpu's stated-but-unenforced invariants.
+
+Each rule is a class with ``code``/``name``/``description`` and a
+``check(ctx) -> Iterator[Finding]``. Codes are stable (they appear in
+baselines and suppression comments):
+
+VL001  VOLSYNC_* env reads outside envflags.py
+VL002  gated third-party imports (zstandard, cryptography) outside shim
+VL003  broad except that swallows silently (no log / re-raise)
+VL004  tracer-unsafe host ops inside jit'd functions (ops/ kernels)
+VL005  direct threading.Lock/RLock in data-plane modules (bypasses
+       lockcheck instrumentation)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from volsync_tpu.analysis.engine import FileContext, Finding
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class EnvFlagRule:
+    """All VOLSYNC_* environment reads go through envflags.py — one
+    falsy-token set, one catalogue of operator knobs."""
+
+    code = "VL001"
+    name = "env-flag-centralized"
+    description = ("os.environ/os.getenv read of a VOLSYNC_* key outside "
+                   "envflags.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.in_module("envflags.py"):
+            return
+        os_names: set[str] = set()
+        environ_names: set[str] = set()
+        getenv_names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "os":
+                        os_names.add(alias.asname or "os")
+            elif isinstance(node, ast.ImportFrom) and node.module == "os":
+                for alias in node.names:
+                    if alias.name == "environ":
+                        environ_names.add(alias.asname or "environ")
+                    elif alias.name == "getenv":
+                        getenv_names.add(alias.asname or "getenv")
+
+        def is_environ(node: ast.AST) -> bool:
+            if isinstance(node, ast.Attribute) and node.attr == "environ":
+                return (isinstance(node.value, ast.Name)
+                        and node.value.id in os_names)
+            return isinstance(node, ast.Name) and node.id in environ_names
+
+        def volsync_key(node: ast.AST) -> Optional[str]:
+            s = _const_str(node)
+            if s is not None and s.startswith("VOLSYNC"):
+                return s
+            return None
+
+        for node in ast.walk(ctx.tree):
+            key = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in ("get", "pop", "setdefault")
+                        and is_environ(f.value) and node.args):
+                    key = volsync_key(node.args[0])
+                elif ((isinstance(f, ast.Attribute) and f.attr == "getenv"
+                       and isinstance(f.value, ast.Name)
+                       and f.value.id in os_names)
+                      or (isinstance(f, ast.Name)
+                          and f.id in getenv_names)) and node.args:
+                    key = volsync_key(node.args[0])
+            elif isinstance(node, ast.Subscript):
+                if (isinstance(node.ctx, ast.Load)
+                        and is_environ(node.value)):
+                    key = volsync_key(node.slice)
+            elif isinstance(node, ast.Compare):
+                if (len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                        and is_environ(node.comparators[0])):
+                    key = volsync_key(node.left)
+            if key is not None:
+                yield Finding(
+                    ctx.relpath, node.lineno, self.code,
+                    f"read of {key!r} outside envflags.py — add/use an "
+                    f"accessor in volsync_tpu/envflags.py")
+
+
+class ImportGateRule:
+    """Optional heavy deps import only behind their shims, so every
+    other module stays importable when the dep is absent."""
+
+    code = "VL002"
+    name = "gated-imports"
+    description = ("zstandard/cryptography imported outside "
+                   "repo/compress.py / repo/crypto.py")
+
+    GATES = {
+        "zstandard": "repo/compress.py",
+        "cryptography": "repo/crypto.py",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            roots: list[str] = []
+            if isinstance(node, ast.Import):
+                roots = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level == 0:  # relative imports can't be the dep
+                    roots = [node.module.split(".")[0]]
+            for root in roots:
+                shim = self.GATES.get(root)
+                if shim is None or ctx.in_module(shim):
+                    continue
+                yield Finding(
+                    ctx.relpath, node.lineno, self.code,
+                    f"import of {root!r} outside {shim} — route through "
+                    f"the shim so its absence degrades instead of "
+                    f"breaking imports")
+
+
+class SilentExceptRule:
+    """A broad except whose body does nothing hides real failures —
+    the invariant-drift class both sync-correctness papers blame."""
+
+    code = "VL003"
+    name = "silent-broad-except"
+    description = ("except Exception/BaseException/bare whose body only "
+                   "passes — no log, no re-raise")
+
+    @staticmethod
+    def _is_broad(type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True  # bare except
+        if isinstance(type_node, ast.Name):
+            return type_node.id in _BROAD_EXC
+        if isinstance(type_node, ast.Attribute):
+            return type_node.attr in _BROAD_EXC
+        if isinstance(type_node, ast.Tuple):
+            return any(SilentExceptRule._is_broad(e)
+                       for e in type_node.elts)
+        return False
+
+    @staticmethod
+    def _is_silent(body: list[ast.stmt]) -> bool:
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)):
+                continue  # docstring / ellipsis
+            return False
+        return True
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if self._is_broad(node.type) and self._is_silent(node.body):
+                yield Finding(
+                    ctx.relpath, node.lineno, self.code,
+                    "broad except swallows the exception silently — "
+                    "re-raise, narrow the type, or log it "
+                    "(`# lint: ignore[VL003]` with a reason if "
+                    "intentional)")
+
+
+class TracerSafetyRule:
+    """Host-side ops on traced values inside a jit'd function either
+    fail at trace time or silently bake a traced value into the
+    compiled graph — both are kernel bugs. Heuristic, scoped to ops/."""
+
+    code = "VL004"
+    name = "jit-tracer-safety"
+    description = ("float()/int()/bool()/.item()/.tolist() or Python "
+                   "branching on a traced arg inside a jit'd function")
+
+    SCOPE_PARTS = ("ops",)
+
+    @staticmethod
+    def _is_jit_expr(node: ast.AST) -> bool:
+        return ((isinstance(node, ast.Name) and node.id == "jit")
+                or (isinstance(node, ast.Attribute) and node.attr == "jit"))
+
+    @classmethod
+    def _jit_static_names(
+            cls, fn: ast.FunctionDef) -> Optional[set[str]]:
+        """None if ``fn`` is not jit-decorated, else the set of
+        static_argnames (traced args are the rest)."""
+        for dec in fn.decorator_list:
+            if cls._is_jit_expr(dec):
+                return set()
+            if not isinstance(dec, ast.Call):
+                continue
+            f = dec.func
+            is_partial = ((isinstance(f, ast.Name) and f.id == "partial")
+                          or (isinstance(f, ast.Attribute)
+                              and f.attr == "partial"))
+            if is_partial and dec.args and cls._is_jit_expr(dec.args[0]):
+                pass
+            elif cls._is_jit_expr(f):
+                pass  # @jax.jit(static_argnames=...)
+            else:
+                continue
+            statics: set[str] = set()
+            for kw in dec.keywords:
+                if kw.arg != "static_argnames":
+                    continue
+                v = kw.value
+                if _const_str(v):
+                    statics.add(_const_str(v))
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    statics.update(
+                        s for s in (_const_str(e) for e in v.elts) if s)
+            return statics
+        return None
+
+    @classmethod
+    def _traced_uses(cls, node: ast.AST, traced: set[str]) -> set[str]:
+        """Traced params used as VALUES in ``node``. Two uses are
+        static even on a traced array and excluded: ``.shape/.dtype/
+        .ndim`` metadata access, and ``is (not) None`` identity checks
+        (the optional-traced-arg idiom all over ops/)."""
+        if (isinstance(node, ast.Attribute)
+                and node.attr in ("shape", "dtype", "ndim")):
+            return set()
+        if (isinstance(node, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops)):
+            return set()
+        if isinstance(node, ast.Name):
+            return {node.id} & traced
+        out: set[str] = set()
+        for child in ast.iter_child_nodes(node):
+            out |= cls._traced_uses(child, traced)
+        return out
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.scope_dirs()
+        if not any(p in parts for p in self.SCOPE_PARTS):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            statics = self._jit_static_names(fn)
+            if statics is None:
+                continue
+            a = fn.args
+            params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+            traced = params - statics
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Name)
+                            and f.id in ("float", "int", "bool")
+                            and len(node.args) == 1
+                            and not isinstance(node.args[0], ast.Constant)
+                            and self._traced_uses(node.args[0], traced)):
+                        yield Finding(
+                            ctx.relpath, node.lineno, self.code,
+                            f"{f.id}() on a traced value inside jit'd "
+                            f"{fn.name}() — forces a host sync or fails "
+                            f"at trace time")
+                    elif (isinstance(f, ast.Attribute)
+                          and f.attr in ("item", "tolist")):
+                        yield Finding(
+                            ctx.relpath, node.lineno, self.code,
+                            f".{f.attr}() inside jit'd {fn.name}() — "
+                            f"host transfer of a traced value")
+                elif isinstance(node, (ast.If, ast.While)):
+                    hot = self._traced_uses(node.test, traced)
+                    if hot:
+                        yield Finding(
+                            ctx.relpath, node.lineno, self.code,
+                            f"Python branch on traced arg(s) "
+                            f"{sorted(hot)} inside jit'd {fn.name}() — "
+                            f"use lax.cond/lax.select")
+
+
+class DirectLockRule:
+    """Data-plane modules construct locks via analysis.lockcheck so
+    VOLSYNC_TPU_LOCKCHECK can instrument them; a direct
+    threading.Lock() there is invisible to the detector."""
+
+    code = "VL005"
+    name = "lockcheck-routed-locks"
+    description = ("direct threading.Lock/RLock construction in a "
+                   "data-plane module (repo/objstore/ops/engine/obs/io)")
+
+    SCOPE_PARTS = ("repo", "objstore", "ops", "engine", "obs", "io")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.scope_dirs()
+        if not any(p in parts for p in self.SCOPE_PARTS):
+            return
+        lock_names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.ImportFrom)
+                    and node.module == "threading"):
+                lock_names.update(
+                    a.asname or a.name for a in node.names
+                    if a.name in ("Lock", "RLock"))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = None
+            if (isinstance(f, ast.Attribute) and f.attr in ("Lock", "RLock")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "threading"):
+                hit = f.attr
+            elif isinstance(f, ast.Name) and f.id in lock_names:
+                hit = f.id
+            if hit:
+                yield Finding(
+                    ctx.relpath, node.lineno, self.code,
+                    f"threading.{hit}() constructed directly — use "
+                    f"analysis.lockcheck.make_{hit.lower()}(name) so "
+                    f"VOLSYNC_TPU_LOCKCHECK can instrument it")
+
+
+def default_rules() -> list:
+    return [EnvFlagRule(), ImportGateRule(), SilentExceptRule(),
+            TracerSafetyRule(), DirectLockRule()]

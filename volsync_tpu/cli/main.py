@@ -90,12 +90,24 @@ def build_parser() -> argparse.ArgumentParser:
     m_del = migsub.add_parser("delete")
     m_del.add_argument("name")
 
+    # Registered for --help discoverability only; run() hands the verb
+    # (with all its options) straight to volsync_tpu.analysis.cli,
+    # which owns the real argument parsing.
+    sub.add_parser(
+        "lint", add_help=False,
+        help="repo-invariant static analysis "
+             "(python -m volsync_tpu.analysis)")
+
     return parser
 
 
 def run(argv, contexts: dict, out=print) -> int:
     """Parse + dispatch. ``contexts`` maps context names to Cluster
     handles (the kubeconfig analogue)."""
+    if argv and argv[0] == "lint":
+        from volsync_tpu.analysis.cli import main as lint_main
+
+        return lint_main(list(argv[1:]), out=out)
     args = build_parser().parse_args(argv)
     config_dir = Path(args.config_dir)
     try:
@@ -140,12 +152,16 @@ def run(argv, contexts: dict, out=print) -> int:
 
 def main(argv=None) -> int:
     """Demo-mode entry: boot a full in-process stack as the 'default'
-    context (the operator's packaged entry point wires real state)."""
+    context (the operator's packaged entry point wires real state).
+    ``volsync lint`` never needs the runtime — dispatch it before the
+    boot so the linter runs in CI containers with no cluster state."""
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "lint":
+        return run(argv, {})
     from volsync_tpu.operator import OperatorRuntime
 
     with OperatorRuntime() as rt:
-        return run(argv if argv is not None else sys.argv[1:],
-                   {"default": rt.cluster})
+        return run(argv, {"default": rt.cluster})
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ import stat as stat_mod
 from pathlib import Path
 from typing import Optional
 
+from volsync_tpu import envflags
 from volsync_tpu.engine.chunker import (
     DeviceChunkHasher,
     params_from_config,
@@ -112,7 +113,7 @@ class TreeBackup:
                 f"params {want}")
         self.skip_if_empty = skip_if_empty
         if workers is None:
-            workers = int(os.environ.get("VOLSYNC_BACKUP_WORKERS", "4"))
+            workers = envflags.backup_workers()
         # A hasher that doesn't declare thread-safety (the mesh-sharded
         # engine: collective enqueue order must match across devices)
         # forces serial file hashing regardless of the knob.

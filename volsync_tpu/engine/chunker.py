@@ -15,6 +15,7 @@ whole stream (see ops/gearcdc.py).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Callable, Iterator, Optional
@@ -35,6 +36,8 @@ from volsync_tpu.ops.sha256 import (
     sha256_chunks_device,
     sha256_leaves_device,
 )
+
+log = logging.getLogger("volsync_tpu.engine")
 
 
 def params_from_config(cfg: dict) -> GearParams:
@@ -508,8 +511,9 @@ def _open_readahead(path, segment_size: int):
 
         if available():
             return ReadaheadReader(path, segment_size)
-    except Exception:  # noqa: BLE001 — native is optional
-        pass
+    except Exception as ex:  # noqa: BLE001 — native is optional
+        log.debug("native readahead unavailable for %s, using plain "
+                  "open(): %s", path, ex)
     return open(path, "rb")
 
 

@@ -15,6 +15,7 @@ import os
 from pathlib import Path
 from typing import Optional
 
+from volsync_tpu import envflags
 from volsync_tpu.repo.repository import Repository
 
 
@@ -28,7 +29,7 @@ class TreeRestore:
         mtime."""
         self.repo = repo
         if workers is None:
-            workers = int(os.environ.get("VOLSYNC_RESTORE_WORKERS", "4"))
+            workers = envflags.restore_workers()
         self.workers = max(1, workers)
         # Device-batched blob verification (same knob as repository
         # check): per-byte re-hashing rides the page-grid kernel in

@@ -11,6 +11,7 @@ here too, as the single catalogue of operator-facing tunables.
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 _FALSY = ("", "0", "false", "no", "off")
 
@@ -34,6 +35,28 @@ def env_int(name: str, default: int, minimum: int = 0) -> int:
         return max(minimum, int(raw.strip()))
     except ValueError:
         return default
+
+
+def env_float(name: str, default: float, minimum: float = 0.0) -> float:
+    """Float knob with the same degrade-to-default contract as
+    env_int."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return max(minimum, float(raw.strip()))
+    except ValueError:
+        return default
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw string knob; empty string counts as unset (an operator
+    clearing a knob with ``VAR=`` means "off", never "the empty
+    path")."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    return raw
 
 
 # -- backup data-plane pipeline knobs (repo/repository.py, engine/chunker.py)
@@ -74,3 +97,95 @@ def readahead_segments() -> int:
     if not pipeline_enabled():
         return 0
     return env_int("VOLSYNC_TPU_READAHEAD", 2, minimum=0)
+
+
+# -- cross-stream segment microbatching knobs (ops/batcher.py) -----------
+
+def batch_segments_override() -> Optional[bool]:
+    """VOLSYNC_BATCH_SEGMENTS tri-state: None when unset (callers fall
+    back to the backend-aware default), else the forced bool."""
+    if os.environ.get("VOLSYNC_BATCH_SEGMENTS") is None:
+        return None
+    return env_bool("VOLSYNC_BATCH_SEGMENTS")
+
+
+def batch_max() -> int:
+    """Max segments coalesced into one batched device dispatch."""
+    return env_int("VOLSYNC_BATCH_MAX", 16, minimum=1)
+
+
+def batch_window_ms() -> float:
+    """How long (ms) the first segment of a batch waits for
+    companions."""
+    return env_float("VOLSYNC_BATCH_WINDOW_MS", 2.0, minimum=0.0)
+
+
+def batch_pipeline_depth() -> int:
+    """Batched dispatches in flight per microbatcher (ops/batcher.py
+    and the gRPC server's per-process batcher share this knob)."""
+    return env_int("VOLSYNC_BATCH_PIPELINE", 2, minimum=1)
+
+
+# -- device kernel knobs (ops/) ------------------------------------------
+
+def root_unroll() -> int:
+    """SHA-256 root-loop unroll factor (ops/segment.py). Read at TRACE
+    time and not part of any jit cache key — profiling runs must set it
+    before the first compile of a shape. Clamped >= 1: U=0 would make
+    the loop body a no-op that never advances n (device hang)."""
+    return env_int("VOLSYNC_ROOT_UNROLL", 4, minimum=1)
+
+
+def no_pallas() -> bool:
+    """VOLSYNC_NO_PALLAS=1 forces the XLA scan everywhere — the
+    operational kill-switch for toolchains without Mosaic support."""
+    return env_bool("VOLSYNC_NO_PALLAS")
+
+
+# -- engine worker knobs (engine/backup.py, engine/restore.py) -----------
+
+def backup_workers() -> int:
+    """Concurrent per-file hashing workers for TreeBackup."""
+    return env_int("VOLSYNC_BACKUP_WORKERS", 4, minimum=1)
+
+
+def restore_workers() -> int:
+    """Concurrent per-file restore workers for TreeRestore."""
+    return env_int("VOLSYNC_RESTORE_WORKERS", 4, minimum=1)
+
+
+# -- observability (obs/tracing.py) --------------------------------------
+
+def trace_dir() -> Optional[str]:
+    """VOLSYNC_TRACE_DIR: where device_trace writes JAX profiler traces;
+    None (the default) disables tracing."""
+    return env_str("VOLSYNC_TRACE_DIR")
+
+
+# -- native accelerator (io/native.py) -----------------------------------
+
+def no_native() -> bool:
+    """VOLSYNC_NO_NATIVE=1 skips the native volio accelerator."""
+    return env_bool("VOLSYNC_NO_NATIVE")
+
+
+def volio_so() -> Optional[str]:
+    """Path to a prebuilt libvolio.so (container images ship one)."""
+    return env_str("VOLSYNC_VOLIO_SO")
+
+
+def native_cache_dir() -> Optional[str]:
+    """Build cache dir for the self-compiled native library."""
+    return env_str("VOLSYNC_NATIVE_CACHE")
+
+
+# -- debug/verification toggles (analysis/lockcheck.py) ------------------
+
+def lockcheck_enabled() -> bool:
+    """VOLSYNC_TPU_LOCKCHECK=1 swaps the data-plane locks for
+    instrumented wrappers that record the per-thread lock-acquisition
+    graph, fail fast on lock-order cycles (potential deadlock), and
+    back the assert_held guards on pipeline shared state. Debug/test
+    only — never on by default (every acquire pays a bookkeeping
+    step)."""
+    return env_bool("VOLSYNC_TPU_LOCKCHECK")

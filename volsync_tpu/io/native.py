@@ -14,16 +14,18 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
 
+from volsync_tpu import envflags
+from volsync_tpu.analysis import lockcheck
+
 log = logging.getLogger("volsync_tpu.native")
 
 _SRC = Path(__file__).resolve().parent.parent.parent / "native" / "volio.cpp"
-_LOCK = threading.Lock()
+_LOCK = lockcheck.make_lock("io.native")
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
@@ -49,9 +51,9 @@ def _load() -> Optional[ctypes.CDLL]:
         if _TRIED:
             return _LIB
         _TRIED = True
-        if os.environ.get("VOLSYNC_NO_NATIVE"):
+        if envflags.no_native():
             return None
-        prebuilt = os.environ.get("VOLSYNC_VOLIO_SO")
+        prebuilt = envflags.volio_so()
         if prebuilt:
             # Container images ship the library pre-compiled (Dockerfile
             # builder stage) — no compiler in the runtime image.
@@ -66,8 +68,8 @@ def _load() -> Optional[ctypes.CDLL]:
             return _LIB
         if not _SRC.is_file():
             return None
-        cache = Path(os.environ.get("VOLSYNC_NATIVE_CACHE",
-                                    str(_SRC.parent / "build")))
+        cache = Path(envflags.native_cache_dir()
+                     or str(_SRC.parent / "build"))
         cache.mkdir(parents=True, exist_ok=True)
         so = cache / "libvolio.so"
         if (not so.is_file()

@@ -25,6 +25,7 @@ the TPU hash pipeline:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import stat as stat_mod
 from concurrent.futures import ThreadPoolExecutor
@@ -38,6 +39,8 @@ from volsync_tpu.objstore.store import (
     get_file,
     put_file,
 )
+
+log = logging.getLogger("volsync_tpu.movers.rclone")
 
 INDEX_KEY = "index.json"  # legacy v1 single-object index (read-only)
 INDEX_MANIFEST = "index/manifest.json"
@@ -131,8 +134,10 @@ class _MirrorLease:
             while not stop.wait(LOCK_REFRESH_SECONDS):
                 try:
                     self._stamp()
-                except Exception:  # noqa: BLE001 — keep mirroring; the
-                    pass           # next beat retries the re-stamp
+                except Exception as ex:  # noqa: BLE001 — keep
+                    # mirroring; the next beat retries the re-stamp
+                    log.debug("mirror lease re-stamp failed "
+                              "(retrying next beat): %s", ex)
         threading.Thread(target=heartbeat, daemon=True,
                          name="mirror-lease").start()
         return self

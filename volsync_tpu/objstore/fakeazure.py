@@ -18,6 +18,7 @@ from typing import Optional
 from urllib.parse import parse_qsl, unquote, urlsplit
 from xml.sax.saxutils import escape
 
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.objstore.azure import sign, string_to_sign
 
 
@@ -30,7 +31,7 @@ class FakeAzureServer:
         self.key_b64 = key_b64
         self.max_results = max_results
         self._blobs: dict[tuple[str, str], bytes] = {}  # (container, name)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("objstore.fakeazure")
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):

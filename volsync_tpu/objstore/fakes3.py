@@ -20,6 +20,7 @@ from typing import Optional
 from urllib.parse import parse_qsl, unquote, urlsplit
 from xml.sax.saxutils import escape
 
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.objstore.s3 import signing_key, string_to_sign
 
 
@@ -33,7 +34,7 @@ class FakeS3Server:
         self.region = region
         self.max_keys = max_keys
         self._objects: dict[tuple[str, str], bytes] = {}  # (bucket, key)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("objstore.fakes3")
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):

@@ -24,6 +24,8 @@ import threading
 from typing import Optional
 from urllib.parse import parse_qsl, unquote, urlsplit
 
+from volsync_tpu.analysis import lockcheck
+
 _ACCOUNT = "AUTH_test"
 
 
@@ -39,7 +41,7 @@ class FakeSwiftServer:
         self.max_results = max_results
         self._objs: dict[tuple[str, str], bytes] = {}  # (container, name)
         self._tokens: set = set()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("objstore.fakeswift")
         self.auth_count = 0  # minted tokens (v1 + v3) — re-auth proof
         outer = self
 

@@ -11,6 +11,8 @@ from __future__ import annotations
 import os
 import threading
 import time
+
+from volsync_tpu.analysis import lockcheck
 from pathlib import Path
 from typing import Iterator, Optional, Protocol
 
@@ -170,7 +172,7 @@ class MemObjectStore:
 
     def __init__(self):
         self._objs: dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("objstore.mem")
 
     def put(self, key: str, data: bytes) -> None:
         _check_key(key)
@@ -230,7 +232,7 @@ class LatencyStore:
         self.puts = 0
         self.max_concurrent_puts = 0
         self._active_puts = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("objstore.latency")
 
     def put(self, key: str, data: bytes) -> None:
         with self._lock:

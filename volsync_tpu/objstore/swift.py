@@ -28,6 +28,7 @@ import threading
 from typing import Iterator, Optional
 from urllib.parse import quote, urlsplit
 
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.objstore.store import NoSuchKey, _check_key
 
 _SAFE = "-_.~/"
@@ -124,7 +125,7 @@ class SwiftObjectStore:
         self.v1_user = v1_user
         self.v1_key = v1_key
         self._pool = _HttpPool()
-        self._auth_lock = threading.Lock()
+        self._auth_lock = lockcheck.make_lock("objstore.swift.auth")
         # Pre-authenticated pair (OS_STORAGE_URL/OS_AUTH_TOKEN) skips
         # the auth round trip entirely; an empty token forces auth on
         # first use.

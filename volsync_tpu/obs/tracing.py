@@ -18,19 +18,20 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 import time
 from collections import defaultdict
 from typing import Optional
 
 from prometheus_client import Histogram
 
+from volsync_tpu import envflags
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
 
 _BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 15, 60,
             float("inf"))
 
-_lock = threading.Lock()
+_lock = lockcheck.make_lock("obs.spans")
 _totals: dict[str, list] = defaultdict(lambda: [0, 0.0])  # name -> [n, secs]
 _histogram: Optional[Histogram] = None
 
@@ -78,7 +79,7 @@ def device_trace(label: str = "volsync"):
     """JAX profiler trace of the wrapped region when VOLSYNC_TRACE_DIR is
     set (TensorBoard 'profile' plugin / xprof reads the output); no-op
     otherwise."""
-    trace_dir = os.environ.get("VOLSYNC_TRACE_DIR")
+    trace_dir = envflags.trace_dir()
     if not trace_dir:
         yield
         return

@@ -11,11 +11,12 @@ non-empty when the worker looks).
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 from concurrent.futures import Future
 
+from volsync_tpu import envflags
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.ops.gearcdc import GearParams
 
 
@@ -166,7 +167,7 @@ class SegmentMicroBatcher:
 
 
 _SHARED: dict = {}
-_SHARED_LOCK = threading.Lock()
+_SHARED_LOCK = lockcheck.make_lock("batcher.shared")
 
 
 def _batching_enabled() -> bool:
@@ -175,10 +176,9 @@ def _batching_enabled() -> bool:
     measured ~7 ms/dispatch execution overhead and ~80 ms result round
     trip make coalescing a clear win there), OFF on the CPU backend
     (compute-bound; batching measurably loses)."""
-    from volsync_tpu.envflags import env_bool
-
-    if os.environ.get("VOLSYNC_BATCH_SEGMENTS") is not None:
-        return env_bool("VOLSYNC_BATCH_SEGMENTS")
+    forced = envflags.batch_segments_override()
+    if forced is not None:
+        return forced
     import jax
 
     return jax.default_backend() == "tpu"
@@ -200,10 +200,7 @@ def shared_batcher(params: GearParams):
         if b is None:
             b = _SHARED[params] = SegmentMicroBatcher(
                 params,
-                max_batch=int(os.environ.get(
-                    "VOLSYNC_BATCH_MAX", "16")),
-                window_ms=float(os.environ.get(
-                    "VOLSYNC_BATCH_WINDOW_MS", "2")),
-                pipeline_depth=int(os.environ.get(
-                    "VOLSYNC_BATCH_PIPELINE", "2")))
+                max_batch=envflags.batch_max(),
+                window_ms=envflags.batch_window_ms(),
+                pipeline_depth=envflags.batch_pipeline_depth())
         return b

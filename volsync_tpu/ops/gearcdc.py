@@ -297,8 +297,10 @@ def select_boundaries(idx_s: np.ndarray, idx_l: np.ndarray, length: int,
                                        eof, base)
         if out is not None:
             return out
-    except Exception:  # noqa: BLE001 — native is an accelerator, not a dep
-        pass
+    except Exception:  # lint: ignore[VL003] — native is an accelerator,
+        pass           # not a dep: ANY native failure falls through to
+        #              # the pure-Python reference on this per-segment
+        #              # hot path (logging here would spam every call)
     return _select_boundaries_py(idx_s, idx_l, length, params, eof=eof,
                                  base=base)
 

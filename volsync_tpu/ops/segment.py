@@ -412,10 +412,11 @@ def _root_digests_loop(flat, n_pages_pad: int, page0, nleaves, lens, live,
     # not parallelism.
     # Tuning knob for profiling runs only: read at TRACE time and not
     # part of any jit cache key, so it must be set before the first
-    # compile of a shape in a fresh process. Clamped: U < 1 would make
-    # the loop body a no-op that never advances n (device hang).
-    import os as _os
-    U = max(1, int(_os.environ.get("VOLSYNC_ROOT_UNROLL", "4")))
+    # compile of a shape in a fresh process. envflags clamps U >= 1
+    # (U = 0 would make the loop body a no-op that never advances n —
+    # device hang).
+    from volsync_tpu import envflags
+    U = envflags.root_unroll()
     jj = jnp.arange(16 * U + 1, dtype=jnp.int32)[None, :]
     q16 = jnp.arange(16, dtype=jnp.int32)[None, :]
 

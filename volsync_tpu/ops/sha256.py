@@ -427,9 +427,9 @@ def use_pallas_leaves() -> bool:
     use the XLA scan (identical digests, golden-tested on both).
     VOLSYNC_NO_PALLAS=1 forces the XLA scan everywhere (operational
     kill-switch for toolchains without Mosaic support)."""
-    import os
+    from volsync_tpu import envflags
 
-    if os.environ.get("VOLSYNC_NO_PALLAS"):
+    if envflags.no_pallas():
         return False
     return jax.default_backend() == "tpu"
 

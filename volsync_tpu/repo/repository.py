@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import threading
 import time as time_mod
 from contextlib import contextmanager
@@ -29,6 +30,7 @@ from typing import Iterable, Optional
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from volsync_tpu import envflags
+from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
 from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
 from volsync_tpu.obs import span
@@ -70,7 +72,9 @@ class UploadError(RepoError):
 # multi-CR movers) does not leak a thread pool per repo. Per-repo
 # backpressure (seal queue limit, upload window) still bounds each
 # repository's in-flight work; the pools just supply the threads.
-_pools_lock = threading.Lock()
+log = logging.getLogger("volsync_tpu.repo")
+
+_pools_lock = lockcheck.make_lock("repo.pools")
 _seal_pool: Optional[ThreadPoolExecutor] = None
 _upload_pool: Optional[ThreadPoolExecutor] = None
 
@@ -160,7 +164,7 @@ class Repository:
         # is ~60 bytes/blob, so a 1 TiB repo (~1M blobs at the default
         # ~1 MiB target) indexes in ~60 MB.
         self._index = CompactIndex()
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("repo.state")
         self._cur_segments: list[bytes] = []
         self._cur_entries: list[dict] = []
         self._cur_size = 0
@@ -353,16 +357,20 @@ class Repository:
                         if stop.is_set():  # released while we were reading
                             break
                         self.store.put(lock_key, json.dumps(info).encode())
-                    except Exception:  # noqa: BLE001 — keep holding
-                        pass
+                    except Exception as ex:  # noqa: BLE001 — keep holding
+                        log.debug("repo lock refresh failed (retrying "
+                                  "next beat): %s", ex)
                 # The refresher owns deletion: by the time we get here any
                 # in-flight refresh put has completed, so the delete cannot
                 # be resurrected behind our back (an orphaned fresh-looking
                 # lock would block exclusive ops for LOCK_STALE_SECONDS).
                 try:
                     self.store.delete(lock_key)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as ex:  # noqa: BLE001 — lock goes
+                    # stale in LOCK_STALE_SECONDS anyway; log so an
+                    # operator can explain the stale-lock wait
+                    log.warning("repo lock release failed (peers wait "
+                                "out staleness): %s", ex)
 
             refresher = threading.Thread(target=refresh, daemon=True)
             refresher.start()
@@ -543,6 +551,7 @@ class Repository:
     def _pl_drain_one(self):
         """Resolve the head of the seal queue into the open pack; close
         the pack when the sealed size crosses PACK_TARGET."""
+        lockcheck.assert_held(self._lock, "repo seal queue (_pl_open)")
         ob = self._pl_open.pop(0)
         seg = ob.fut.result()
         self._cur_entries.append({
@@ -569,6 +578,7 @@ class Repository:
         """Hand the open pack to the upload stage. Blocks while the
         in-flight window (VOLSYNC_TPU_UPLOAD_WINDOW) is full — that
         bounds sealed pack bytes held in memory."""
+        lockcheck.assert_held(self._lock, "open pack buffer (_cur_*)")
         if not self._cur_segments:
             return
         body = b"".join(self._cur_segments)
@@ -611,6 +621,8 @@ class Repository:
         delta, persist deltas at the limit — the same delta grouping as
         the serial path. A failed upload records the error and registers
         NOTHING, so no persisted index object can reference its pack."""
+        lockcheck.assert_held(self._lock,
+                              "upload window (_pl_inflight) + index")
         while (self._pl_inflight
                and (block or self._pl_inflight[0].fut.done())):
             pk = self._pl_inflight.pop(0)
@@ -688,6 +700,8 @@ class Repository:
 
     def _persist_pending(self):
         """Write buffered index entries as one index delta object."""
+        lockcheck.assert_held(self._lock,
+                              "pending index buffer (_pending_index)")
         if not self._pending_index:
             return
         payload = self.box.seal(self._zc.compress(json.dumps(

@@ -89,8 +89,9 @@ class MoverJaxServer:
         self._batcher = None
         if batch_window_ms > 0 and self.params.align == 4096:
             if pipeline_depth is None:
-                pipeline_depth = int(os.environ.get(
-                    "VOLSYNC_BATCH_PIPELINE", "2"))
+                from volsync_tpu import envflags
+
+                pipeline_depth = envflags.batch_pipeline_depth()
             self._batcher = SegmentMicroBatcher(
                 self.params, window_ms=batch_window_ms,
                 max_batch=max_workers, pipeline_depth=pipeline_depth)
