@@ -1,0 +1,21 @@
+# Developer entry points; the canonical pre-push gate is
+# scripts/static_check.sh (lint + lockcheck-armed suites) and the
+# tier-1 command in ROADMAP.md.
+
+.PHONY: lint test static-check clean-lint
+
+# Cached SARIF lint over the whole tree (package + scripts/ + bench.py).
+# Warm runs re-analyze zero files; see docs/development.md.
+lint:
+	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
+	    --no-baseline --format sarif --out lint.sarif --cache .lint-cache
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	    -p no:cacheprovider
+
+static-check:
+	scripts/static_check.sh
+
+clean-lint:
+	rm -f lint.sarif .lint-cache

@@ -57,6 +57,9 @@ import time
 
 import numpy as np
 
+# envflags imports only os — safe before the JAX env setup below.
+from volsync_tpu.envflags import env_bool, env_int, env_str, no_pallas
+
 # Persistent compilation cache: retries and later rounds reuse compiled
 # executables instead of paying the 20-40s first compile again. Must be
 # set before jax is imported anywhere in this process.
@@ -78,14 +81,11 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 # (configs x per-config deadline) must fit inside its timeout.
 PROBE_TIMEOUTS = (120, 200)
 PROBE_BACKOFF_S = 15
-CONFIG_DEADLINE_S = int(os.environ.get("VOLSYNC_BENCH_CONFIG_DEADLINE", "420"))
-CPU_CONFIG_DEADLINE_S = int(os.environ.get(
-    "VOLSYNC_BENCH_CPU_CONFIG_DEADLINE", "240"))
-MEASURE_TIMEOUT_S = int(os.environ.get("VOLSYNC_BENCH_MEASURE_TIMEOUT",
-                                       "1800"))
-CPU_MEASURE_TIMEOUT_S = int(os.environ.get(
-    "VOLSYNC_BENCH_CPU_MEASURE_TIMEOUT", "1200"))
-GLOBAL_BUDGET_S = int(os.environ.get("VOLSYNC_BENCH_BUDGET_S", "3600"))
+CONFIG_DEADLINE_S = env_int("VOLSYNC_BENCH_CONFIG_DEADLINE", 420)
+CPU_CONFIG_DEADLINE_S = env_int("VOLSYNC_BENCH_CPU_CONFIG_DEADLINE", 240)
+MEASURE_TIMEOUT_S = env_int("VOLSYNC_BENCH_MEASURE_TIMEOUT", 1800)
+CPU_MEASURE_TIMEOUT_S = env_int("VOLSYNC_BENCH_CPU_MEASURE_TIMEOUT", 1200)
+GLOBAL_BUDGET_S = env_int("VOLSYNC_BENCH_BUDGET_S", 3600)
 
 _log = functools.partial(print, file=sys.stderr, flush=True)
 
@@ -238,8 +238,8 @@ def _recover_backend() -> Optional[str]:
         name = _probe_backend(timeouts=(120,))
         if name is not None:
             return name
-    quiet_s = int(os.environ.get("VOLSYNC_BENCH_RECOVERY_QUIET", "600"))
-    max_probes = int(os.environ.get("VOLSYNC_BENCH_RECOVERY_PROBES", "2"))
+    quiet_s = env_int("VOLSYNC_BENCH_RECOVERY_QUIET", 600)
+    max_probes = env_int("VOLSYNC_BENCH_RECOVERY_PROBES", 2)
     for i in range(max_probes):
         wait = min(quiet_s, _budget_left() - reserve - 140)
         if wait <= 60:
@@ -379,7 +379,7 @@ def _try_device_throughput(seg_mib: int, streams: int, iters: int) -> float:
 
 def _config_deadline_s() -> int:
     return (CPU_CONFIG_DEADLINE_S
-            if os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK")
+            if env_bool("VOLSYNC_BENCH_CPU_FALLBACK")
             else CONFIG_DEADLINE_S)
 
 
@@ -397,7 +397,7 @@ def _try_batched_throughput(seg_mib: int, streams: int, iters: int,
     concurrent movers. Default 2; VOLSYNC_BENCH_PIPELINES overrides so
     bench_self rungs can A/B the depth on hardware."""
     if pipelines is None:
-        pipelines = int(os.environ.get("VOLSYNC_BENCH_PIPELINES", "2"))
+        pipelines = env_int("VOLSYNC_BENCH_PIPELINES", 2)
     import functools as _ft
     from concurrent.futures import ThreadPoolExecutor
 
@@ -552,14 +552,15 @@ def _run_config_ladder() -> tuple[float, str]:
     # 1740 s watchdog with headroom for the golden checks and the CPU
     # baseline — 3x420 + overhead fits, 4x420 could clip the last rung.
     configs = [("B", 64, 8, 6), ("B", 32, 8, 8), ("S", 32, 4, 4)]
-    if os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
+    if env_bool("VOLSYNC_BENCH_CPU_FALLBACK"):
         # CPU-backend XLA scan is orders slower; tiny configs + the
         # per-config deadline still land an honest labeled number.
         configs = [("S", 8, 2, 1), ("S", 4, 1, 1), ("S", 2, 1, 1),
                    ("S", 1, 1, 1)]
-    pinned = bool(os.environ.get("VOLSYNC_BENCH_CONFIG"))
-    if pinned:
-        configs = [_parse_config(os.environ["VOLSYNC_BENCH_CONFIG"])]
+    pinned_config = env_str("VOLSYNC_BENCH_CONFIG")
+    pinned = bool(pinned_config)
+    if pinned_config:
+        configs = [_parse_config(pinned_config)]
     last_err: BaseException | None = None
     best: Optional[tuple[float, str]] = None
     for kind, seg_mib, streams, iters in configs:
@@ -591,7 +592,7 @@ def _run_config_ladder() -> tuple[float, str]:
     # Opportunistic upsizing: one real-hardware run per round, so while
     # budget clearly remains, probe bigger shapes and keep the max. A
     # failure here never loses the number already in hand.
-    if not pinned and not os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
+    if not pinned and not env_bool("VOLSYNC_BENCH_CPU_FALLBACK"):
         kind, rest = best[1][0], best[1][1:]
         seg, streams, iters = map(int, rest.split("x"))
         for up in (
@@ -641,7 +642,7 @@ def device_throughput() -> tuple[float, str]:
     try:
         return _run_config_ladder()
     except AssertionError as e:
-        if os.environ.get("VOLSYNC_NO_PALLAS"):
+        if no_pallas():
             raise  # already on the XLA path: the math itself is wrong
         # A golden-check failure with Pallas enabled points at the
         # Mosaic kernels on this toolchain; the XLA scan path computes
@@ -653,7 +654,8 @@ def device_throughput() -> tuple[float, str]:
         _log(f"bench: golden check failed with Pallas enabled ({e}); "
              f"retrying on the XLA path (VOLSYNC_NO_PALLAS=1)")
         os.environ["VOLSYNC_NO_PALLAS"] = "1"
-        os.environ.setdefault("VOLSYNC_BENCH_CONFIG", "64,8,6")
+        if env_str("VOLSYNC_BENCH_CONFIG") is None:
+            os.environ["VOLSYNC_BENCH_CONFIG"] = "64,8,6"
         import jax
 
         jax.clear_caches()  # cached executables still contain Pallas
@@ -827,9 +829,10 @@ def _inner_main():
     parent applies the next fallback. The inner watchdog still emits a
     completed result if the interpreter wedges on the way out."""
     global _BEST
-    threading.Thread(target=_watchdog, daemon=True).start()
+    threading.Thread(target=_watchdog, name="bench-watchdog",
+                     daemon=True).start()
     backend = "default"
-    if os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
+    if env_bool("VOLSYNC_BENCH_CPU_FALLBACK"):
         _force_cpu_backend()
         backend = "cpu-fallback"
     dev, config = device_throughput()
@@ -906,11 +909,12 @@ def main():
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         _emit(pipeline_bench())
         return 0
-    if os.environ.get("VOLSYNC_BENCH_INNER"):
+    if env_bool("VOLSYNC_BENCH_INNER"):
         return _inner_main()
-    threading.Thread(target=_watchdog, daemon=True).start()
+    threading.Thread(target=_watchdog, name="bench-watchdog",
+                     daemon=True).start()
 
-    if not os.environ.get("VOLSYNC_BENCH_CPU_FALLBACK"):
+    if not env_bool("VOLSYNC_BENCH_CPU_FALLBACK"):
         probed = _probe_backend()
         if probed is None:
             probed = _recover_backend()

@@ -30,6 +30,9 @@ import time
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from volsync_tpu.envflags import env_int  # noqa: E402
 DEFAULT_RUNGS = [
     "B:64,8,6",                       # primary batched shape (r4 rung 1)
     "B:128,8,3",                      # 2x bytes per dispatch (segment)
@@ -38,7 +41,7 @@ DEFAULT_RUNGS = [
     "VOLSYNC_PAGEMAJOR=1:B:64,8,6",   # page-major digest-table A/B
     "S:64,8,6",                       # per-stream fused shape, same size
 ]
-RUNG_BUDGET_S = int(os.environ.get("VOLSYNC_SELF_RUNG_BUDGET", "1100"))
+RUNG_BUDGET_S = env_int("VOLSYNC_SELF_RUNG_BUDGET", 1100)
 
 #: A/B knobs rung specs may set: stripped from the ambient environment
 #: so a leftover export can't silently skew the baseline rungs or break

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from volsync_tpu.envflags import root_unroll
 from volsync_tpu.ops import segment as seg
 from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS
 
@@ -109,7 +110,7 @@ def root_pagemajor(fl, s):
 
 
 print(f"== {SEG_MIB} MiB, backend={jax.default_backend()}, "
-      f"U={os.environ.get('VOLSYNC_ROOT_UNROLL', '4')}", flush=True)
+      f"U={root_unroll()}", flush=True)
 timeit("full fused", full, base)
 timeit("pages only", pages, base)
 timeit("root only (word-major)", root_only, flat0)

@@ -30,13 +30,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
+from volsync_tpu.envflags import (  # noqa: E402
+    env_bool, env_float, env_int)
+
 
 def main() -> int:
-    clients = int(os.environ.get("VOLSYNC_SVCBENCH_CLIENTS", "8"))
-    mib = int(os.environ.get("VOLSYNC_SVCBENCH_MIB", "64"))
-    seg_kib = int(os.environ.get("VOLSYNC_SVCBENCH_SEG_KIB", "4096"))
-    window_ms = float(os.environ.get("VOLSYNC_SVCBENCH_WINDOW_MS", "2"))
-    if os.environ.get("VOLSYNC_SVCBENCH_CPU"):
+    clients = env_int("VOLSYNC_SVCBENCH_CLIENTS", 8)
+    mib = env_int("VOLSYNC_SVCBENCH_MIB", 64)
+    seg_kib = env_int("VOLSYNC_SVCBENCH_SEG_KIB", 4096)
+    window_ms = env_float("VOLSYNC_SVCBENCH_WINDOW_MS", 2.0)
+    if env_bool("VOLSYNC_SVCBENCH_CPU"):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -91,8 +94,11 @@ def main() -> int:
             errors.append(f"client {idx}: {e}")
 
     def run_all(srv, bufs: list):
-        threads = [threading.Thread(target=run_one, args=(srv, i, bufs))
-                   for i in range(clients)]
+        threads = []
+        for i in range(clients):
+            t = threading.Thread(target=run_one, args=(srv, i, bufs),
+                                 name=f"svcbench-client-{i}")
+            threads.append(t)
         t0 = time.perf_counter()
         for t in threads:
             t.start()

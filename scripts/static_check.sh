@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
 # Local static-analysis + concurrency gate (docs/development.md).
 #
-#   1. `volsync lint` over the shipped package — must be clean with no
-#      baseline (tests/test_analysis.py enforces the same in tier-1).
+#   1. `volsync lint` over the whole tree — package, scripts/ and
+#      bench.py — must be clean with no baseline
+#      (tests/test_analysis.py enforces the same in tier-1). Emits a
+#      SARIF 2.1.0 report to lint.sarif for CI upload and uses the
+#      content-hash incremental cache (.lint-cache): a warm run
+#      re-analyzes zero files.
 #   2. The pipeline + crash-recovery suites with the lock-order/race
 #      detector armed at process start (VOLSYNC_TPU_LOCKCHECK=1), so
 #      module-level locks are instrumented too.
@@ -12,7 +16,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== volsync lint =="
-python -m volsync_tpu.analysis volsync_tpu/ --no-baseline
+python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
+    --no-baseline --format sarif --out lint.sarif --cache .lint-cache
 
 echo "== lockcheck-armed pipeline suites =="
 JAX_PLATFORMS=cpu VOLSYNC_TPU_LOCKCHECK=1 \

@@ -1,0 +1,13 @@
+"""Host-side helpers a jit'd kernel must not feed traced values into:
+``decide`` branches on its first parameter directly; ``route`` reaches
+the same sink one hop down. Parsed only, never imported."""
+
+
+def decide(flag, limit):
+    if flag:
+        return limit
+    return 0
+
+
+def route(x):
+    return decide(x, 4)

@@ -1,0 +1,20 @@
+"""VL104 fixture: a jit'd kernel leaking traced values into host
+control flow through helper calls (module alias and from-import) and
+branching on a tracer-derived local. Parsed only, never imported."""
+import functools
+
+import jax
+
+from miniproj.ops import helpers as hp
+from miniproj.ops.helpers import route as _route
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(x, n):
+    y = x + 1
+    if n > 2:
+        return _route(y)  # MARK: taint-via-route
+    z = y * 2
+    if z > 0:  # MARK: derived-branch
+        return z
+    return hp.decide(x, n)  # MARK: taint-direct

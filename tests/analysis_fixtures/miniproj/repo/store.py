@@ -1,0 +1,52 @@
+"""VL101 fixture: lock regions reaching blocking calls through
+resolved helper calls — aliased from-import, self-method dispatch, and
+base-class method lookup — plus clean counterparts and one reviewed
+suppression. Deliberately violating; linted by tests, never imported.
+"""
+import time as _t
+
+from miniproj.repo.util import drain as pump
+
+
+def make_lock(name):
+    return name
+
+
+def make_rlock(name):
+    return name
+
+
+_LOCK = make_lock("miniproj.repo.module")
+
+
+def module_sync():
+    with _LOCK:
+        _t.sleep(0)  # MARK: direct-sleep
+
+
+class Store:
+    def __init__(self):
+        self._lock = make_rlock("miniproj.repo.store")
+
+    def flush(self):
+        with self._lock:
+            pump()  # MARK: two-hop
+
+    def flush_ok(self):
+        with self._lock:
+            staged = []
+        pump()
+        return staged
+
+
+class Cache(Store):
+    def refresh(self):
+        with self._lock:
+            self._write()  # MARK: self-method
+
+    def _write(self):
+        pump()
+
+    def reviewed(self):
+        with self._lock:  # lint: ignore[VL101] — fixture: suppression
+            pump()

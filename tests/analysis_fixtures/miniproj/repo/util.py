@@ -1,0 +1,11 @@
+"""Helpers sitting two call-hops above a blocking sink — the VL101
+chain fixture's far end. Never imported at runtime; parsed only."""
+import time
+
+
+def _slow():
+    time.sleep(0.01)
+
+
+def drain():
+    _slow()

@@ -1,7 +1,11 @@
-"""The analyzer analyzed: seeded-violation fixtures per rule, baseline
-add/expire, suppression comments, and the tier-1 gate — `volsync lint`
-runs clean over the shipped package with NO baseline."""
+"""The analyzer analyzed: seeded-violation fixtures per rule (per-file
+VL001-VL005 and interprocedural VL101-VL104), call-graph resolution
+over the committed mini-package in ``analysis_fixtures/``, baseline
+add/expire, suppression comments, SARIF emission, the incremental
+cache, and the tier-1 gate — `volsync lint` runs clean over the
+shipped package, ``scripts/`` and ``bench.py`` with NO baseline."""
 
+import json
 from pathlib import Path
 
 import volsync_tpu
@@ -9,10 +13,13 @@ from volsync_tpu.analysis import (
     apply_baseline,
     load_baseline,
     run_lint,
+    run_project,
     write_baseline,
 )
 from volsync_tpu.analysis.cli import main as lint_main
 from volsync_tpu.cli.main import run as cli_run
+
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
 
 
 def _lint_file(tmp_path, source, name="mod.py", subdir=None):
@@ -148,6 +155,308 @@ def test_syntax_error_is_reported(tmp_path):
     assert len(errors) == 1 and "bad.py" in errors[0]
 
 
+# -- interprocedural rules (call graph + dataflow) --------------------------
+
+def _mark_line(path: Path, marker: str) -> int:
+    """1-based line of the fixture statement tagged ``# MARK: <marker>``."""
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        if f"MARK: {marker}" in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in {path}")
+
+
+def test_vl101_interprocedural_fixture_package():
+    """The committed mini-package exercises the resolver end to end:
+    from-import-as aliasing, self-method dispatch, base-class lock
+    lookup — and a blocking call TWO call-hops below a ``with lock:``
+    region is reported with its hop chain."""
+    res = run_project([str(FIXTURES / "miniproj")])
+    assert res.errors == []
+    store = FIXTURES / "miniproj" / "repo" / "store.py"
+    vl101 = [f for f in res.findings if f.code == "VL101"]
+    assert all(f.path.endswith("repo/store.py") for f in vl101)
+    by_line = {f.line: f for f in vl101}
+    assert set(by_line) == {_mark_line(store, "direct-sleep"),
+                            _mark_line(store, "two-hop"),
+                            _mark_line(store, "self-method")}
+
+    direct = by_line[_mark_line(store, "direct-sleep")]
+    assert "time.sleep()" in direct.message
+    assert "lock 'miniproj.repo.module'" in direct.message
+
+    # the acceptance example: sink two hops below the region header,
+    # found through an aliased from-import (`drain as pump`)
+    two_hop = by_line[_mark_line(store, "two-hop")]
+    assert "via drain() -> _slow()" in two_hop.message
+    assert "lock 'miniproj.repo.store'" in two_hop.message
+    assert two_hop.severity == "error"
+
+    # self-method call resolved through the subclass, lock attribute
+    # resolved through the base class
+    self_m = by_line[_mark_line(store, "self-method")]
+    assert "via _write() -> drain() -> _slow()" in self_m.message
+    # flush_ok (call outside the region) and the suppressed `reviewed`
+    # region produced nothing — the three above are ALL the findings
+
+
+def test_vl104_interprocedural_taint_fixture():
+    """Traced values flowing through helper calls (module alias and
+    from-import alias) into host branches, and branches on
+    tracer-derived locals."""
+    res = run_project([str(FIXTURES / "miniproj")])
+    kern = FIXTURES / "miniproj" / "ops" / "kern.py"
+    vl104 = [f for f in res.findings if f.code == "VL104"]
+    assert all(f.path.endswith("ops/kern.py") for f in vl104)
+    by_line = {f.line: f for f in vl104}
+    assert set(by_line) == {_mark_line(kern, "taint-via-route"),
+                            _mark_line(kern, "derived-branch"),
+                            _mark_line(kern, "taint-direct")}
+    via = by_line[_mark_line(kern, "taint-via-route")]
+    assert "via route() -> decide()" in via.message
+    assert via.severity == "error"
+    derived = by_line[_mark_line(kern, "derived-branch")]
+    assert "tracer-derived" in derived.message and "'z'" in derived.message
+    direct = by_line[_mark_line(kern, "taint-direct")]
+    assert "decide(" in direct.message
+    # nothing else fires on the fixture package
+    assert {f.code for f in res.findings} == {"VL101", "VL104"}
+
+
+def test_vl101_regions_and_comment_above_suppression(tmp_path):
+    src = (
+        "import time\n"
+        "def make_lock(name):\n"
+        "    return name\n"
+        "_L = make_lock('t.lock')\n"
+        "def hot():\n"
+        "    with _L:\n"
+        "        time.sleep(1)\n"
+        "def reviewed():\n"
+        "    # lint: ignore[VL101] -- held for atomicity only\n"
+        "    with _L:\n"
+        "        time.sleep(1)\n"
+        "def bare():\n"
+        "    _L.acquire()\n"
+        "    try:\n"
+        "        time.sleep(1)\n"
+        "    finally:\n"
+        "        _L.release()\n"
+        "def after_release():\n"
+        "    _L.acquire()\n"
+        "    try:\n"
+        "        pass\n"
+        "    finally:\n"
+        "        _L.release()\n"
+        "    time.sleep(1)\n"
+    )
+    findings = _lint_file(tmp_path, src, subdir="engine")
+    assert _codes(findings) == ["VL101", "VL101"]
+    # the with-region sink and the bare acquire()..release() region
+    # sink; the comment-above suppression and post-release sleep don't
+    assert {f.line for f in findings} == {7, 15}
+
+
+def test_vl102_thread_lifecycle(tmp_path):
+    src = (
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def unnamed_daemon():\n"
+        "    threading.Thread(target=print, daemon=True).start()\n"
+        "def named_joined():\n"
+        "    t = threading.Thread(target=print, name='w')\n"
+        "    t.start()\n"
+        "    t.join()\n"
+        "def named_leaked():\n"
+        "    t = threading.Thread(target=print, name='w2')\n"
+        "    t.start()\n"
+        "def pool_leaked():\n"
+        "    ex = ThreadPoolExecutor(max_workers=2)\n"
+        "    return ex.submit(print)\n"
+        "def pool_with():\n"
+        "    with ThreadPoolExecutor(max_workers=2) as ex:\n"
+        "        ex.submit(print)\n"
+        "def pool_transferred(server):\n"
+        "    return server(ThreadPoolExecutor(max_workers=2))\n"
+    )
+    findings = _lint_file(tmp_path, src)
+    assert _codes(findings) == ["VL102"] * 3
+    assert {f.line for f in findings} == {4, 10, 13}
+    msgs = " / ".join(f.message for f in findings)
+    assert "without name=" in msgs
+    assert "no reachable .join()" in msgs
+    assert "no reachable .shutdown()" in msgs
+
+
+def test_vl103_exception_path_leak(tmp_path):
+    src = (
+        "def leak(lock):\n"
+        "    lock.acquire()\n"
+        "    do()\n"
+        "    lock.release()\n"
+        "def ok_finally(lock):\n"
+        "    lock.acquire()\n"
+        "    try:\n"
+        "        do()\n"
+        "    finally:\n"
+        "        lock.release()\n"
+        "def ok_reraise(slots):\n"
+        "    slots.acquire()\n"
+        "    try:\n"
+        "        do()\n"
+        "    except Exception:\n"
+        "        slots.release()\n"
+        "        raise\n"
+        "def leak_open(p):\n"
+        "    f = open(p)\n"
+        "    return f.read()\n"
+        "def ok_open(p):\n"
+        "    f = open(p)\n"
+        "    try:\n"
+        "        return f.read()\n"
+        "    finally:\n"
+        "        f.close()\n"
+        "def ok_with(p):\n"
+        "    with open(p) as f:\n"
+        "        return f.read()\n"
+    )
+    findings = _lint_file(tmp_path, src, subdir="repo")
+    assert _codes(findings) == ["VL103", "VL103"]
+    assert {f.line for f in findings} == {2, 19}
+    # out of the data-plane scope the rule stays silent
+    assert _lint_file(tmp_path, src, subdir="cluster") == []
+
+
+# -- incremental cache ------------------------------------------------------
+
+def test_cache_warm_run_and_transitive_invalidation(tmp_path):
+    a, b, c = (tmp_path / n for n in ("a.py", "b.py", "c.py"))
+    c.write_text("import os\n"
+                 "import time\n"
+                 "V = os.environ.get('VOLSYNC_CACHED')\n"
+                 "def slow():\n"
+                 "    time.sleep(1)\n")
+    b.write_text("import c\n"
+                 "def mid():\n"
+                 "    c.slow()\n")
+    a.write_text("import b\n"
+                 "def top():\n"
+                 "    b.mid()\n")
+    cache = tmp_path / ".lint-cache"
+
+    cold = run_project([str(tmp_path)], cache_path=cache)
+    assert cold.errors == []
+    assert sorted(cold.analyzed) == sorted(
+        p.as_posix() for p in (a, b, c))
+    assert [f.code for f in cold.findings] == ["VL001"]
+
+    # warm: identical tree -> ZERO files re-analyzed, findings served
+    # verbatim from the cache
+    warm = run_project([str(tmp_path)], cache_path=cache)
+    assert warm.analyzed == []
+    assert warm.total == 3
+    assert [(f.path, f.line, f.code, f.message, f.severity)
+            for f in warm.findings] == [
+        (f.path, f.line, f.code, f.message, f.severity)
+        for f in cold.findings]
+
+    # editing the leaf callee re-analyzes it AND its transitive
+    # reverse importers (b imports c, a imports b)
+    c.write_text(c.read_text().replace("time.sleep(1)", "time.sleep(2)"))
+    edited = run_project([str(tmp_path)], cache_path=cache)
+    assert sorted(edited.analyzed) == sorted(
+        p.as_posix() for p in (a, b, c))
+
+    # an unrelated new file re-analyzes only itself
+    d = tmp_path / "d.py"
+    d.write_text("X = 1\n")
+    extended = run_project([str(tmp_path)], cache_path=cache)
+    assert extended.analyzed == [d.as_posix()]
+    assert [f.code for f in extended.findings] == ["VL001"]
+
+
+def test_cache_rejected_on_rule_set_change(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("X = 1\n")
+    cache = tmp_path / ".lint-cache"
+    run_project([str(tmp_path)], cache_path=cache)
+
+    class FakeRule:
+        code = "VL999"
+        name = "fake"
+        description = "fake"
+
+        def check(self, ctx):
+            return iter(())
+
+    from volsync_tpu.analysis.rules import default_rules
+    res = run_project([str(tmp_path)], rules=default_rules() + [FakeRule()],
+                      cache_path=cache)
+    # different rule signature -> cache miss -> full re-analysis
+    assert res.analyzed == [mod.as_posix()]
+
+
+def test_cli_cache_stat_line(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("X = 1\n")
+    cache = tmp_path / ".lint-cache"
+    lines = []
+    rc = lint_main([str(mod), "--no-baseline", "--cache", str(cache)],
+                   out=lines.append)
+    assert rc == 0
+    lines.clear()
+    rc = lint_main([str(mod), "--no-baseline", "--cache", str(cache)],
+                   out=lines.append)
+    assert rc == 0
+    assert any(ln.startswith("cache: analyzed 0 of 1") for ln in lines)
+
+
+# -- SARIF ------------------------------------------------------------------
+
+def test_sarif_output_shape(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("import os\nx = os.environ.get('VOLSYNC_X')\n")
+    out_file = tmp_path / "lint.sarif"
+    lines = []
+    rc = lint_main([str(mod), "--no-baseline", "--format", "sarif",
+                    "--out", str(out_file)], out=lines.append)
+    assert rc == 1
+    doc = json.loads(out_file.read_text())
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0.json" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "volsync-lint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    for code in ("VL001", "VL101", "VL102", "VL103", "VL104"):
+        assert code in rule_ids
+    for r in driver["rules"]:
+        assert r["defaultConfiguration"]["level"] in (
+            "error", "warning", "note")
+    assert run["invocations"][0]["executionSuccessful"] is True
+    (res,) = run["results"]
+    assert res["ruleId"] == "VL001"
+    assert res["level"] == "warning"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("m.py")
+    assert loc["region"]["startLine"] == 2
+    assert rule_ids[res["ruleIndex"]] == "VL001"
+
+
+def test_sarif_parse_error_notification(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    lines = []
+    rc = lint_main([str(bad), "--no-baseline", "--format", "sarif"],
+                   out=lines.append)
+    assert rc == 1
+    doc = json.loads("\n".join(lines))
+    inv = doc["runs"][0]["invocations"][0]
+    assert inv["executionSuccessful"] is False
+    notes = inv["toolExecutionNotifications"]
+    assert len(notes) == 1 and "bad.py" in notes[0]["message"]["text"]
+
+
 # -- baseline add / expire --------------------------------------------------
 
 def test_baseline_roundtrip_and_expiry(tmp_path):
@@ -229,11 +538,19 @@ def test_volsync_cli_lint_verb(tmp_path):
 # -- the tier-1 gate --------------------------------------------------------
 
 def test_package_is_lint_clean():
-    """The whole shipped package passes every rule with NO baseline:
-    the repo's stated invariants (env reads via envflags, gated
-    imports, no silent swallows, tracer-safe kernels, lockcheck-routed
-    locks) hold right now, and this test keeps them held."""
+    """The whole shipped tree — the package, ``scripts/`` and
+    ``bench.py`` — passes every rule (per-file AND interprocedural)
+    with NO baseline: the repo's stated invariants (env reads via
+    envflags, gated imports, no silent swallows, tracer-safe kernels,
+    lockcheck-routed locks, no blocking I/O under locks, named/joined
+    threads, exception-safe acquires) hold right now, and this test
+    keeps them held."""
     pkg = Path(volsync_tpu.__file__).resolve().parent
-    findings, errors = run_lint([str(pkg)])
+    paths = [str(pkg)]
+    repo_root = pkg.parent
+    for extra in (repo_root / "scripts", repo_root / "bench.py"):
+        if extra.exists():  # absent when only the package is installed
+            paths.append(str(extra))
+    findings, errors = run_lint(paths)
     assert errors == []
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)

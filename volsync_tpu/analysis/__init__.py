@@ -2,12 +2,18 @@
 
 Two halves:
 
-* ``volsync_tpu.analysis.engine`` / ``rules`` — an AST lint pass
-  (``python -m volsync_tpu.analysis``, also ``volsync lint``) enforcing
-  the invariants the code states but Python can't: env knobs parse only
-  through envflags.py, optional heavy deps stay behind their shims,
-  no silent exception swallowing, tracer-unsafe host ops stay out of
-  jit'd kernels, data-plane locks route through lockcheck.
+* ``volsync_tpu.analysis.engine`` / ``rules`` / ``iprules`` — an AST
+  lint pass (``python -m volsync_tpu.analysis``, also ``volsync
+  lint``) enforcing the invariants the code states but Python can't:
+  env knobs parse only through envflags.py, optional heavy deps stay
+  behind their shims, no silent exception swallowing, tracer-unsafe
+  host ops stay out of jit'd kernels, data-plane locks route through
+  lockcheck (VL001-VL005, per file); plus the interprocedural family
+  over the project call graph (``callgraph``/``dataflow``): no
+  blocking I/O under a lockcheck lock, thread/executor lifecycle,
+  exception-path resource leaks, tracer taint through helper calls
+  (VL101-VL104). SARIF/JSON output and a content-hash incremental
+  cache live in ``sarif``/``cache``.
 
 * ``volsync_tpu.analysis.lockcheck`` — a debug-flag
   (``VOLSYNC_TPU_LOCKCHECK=1``) runtime detector that records the
@@ -18,15 +24,19 @@ Two halves:
 
 from volsync_tpu.analysis.engine import (
     Finding,
+    LintResult,
     apply_baseline,
     load_baseline,
     run_lint,
+    run_project,
     write_baseline,
 )
 
 __all__ = [
     "Finding",
+    "LintResult",
     "run_lint",
+    "run_project",
     "load_baseline",
     "apply_baseline",
     "write_baseline",

@@ -8,14 +8,14 @@ or unparsable files, 2 usage errors.
 from __future__ import annotations
 
 import argparse
-import sys
+import json
 from pathlib import Path
 from typing import Optional
 
 from volsync_tpu.analysis.engine import (
     apply_baseline,
     load_baseline,
-    run_lint,
+    run_project,
     write_baseline,
 )
 
@@ -26,7 +26,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="volsync lint",
         description="Repo-invariant AST lint for volsync-tpu "
-                    "(rules VL001-VL005; see docs/development.md)")
+                    "(per-file rules VL001-VL005, interprocedural "
+                    "rules VL101-VL104; see docs/development.md)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to lint (default: the installed "
@@ -44,15 +45,31 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print rule codes/descriptions and exit")
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format for findings (default: text)")
+    parser.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="write json/sarif output to FILE instead of stdout")
+    parser.add_argument(
+        "--cache", default=None, metavar="FILE",
+        help="incremental cache file: re-analyze only changed files "
+             "and their reverse import dependencies")
     return parser
+
+
+def _all_rules():
+    from volsync_tpu.analysis.iprules import default_project_rules
+    from volsync_tpu.analysis.rules import default_rules
+
+    return default_rules(), default_project_rules()
 
 
 def main(argv: Optional[list] = None, out=print) -> int:
     args = build_parser().parse_args(argv)
+    rules, project_rules = _all_rules()
     if args.list_rules:
-        from volsync_tpu.analysis.rules import default_rules
-
-        for rule in default_rules():
+        for rule in rules + project_rules:
             out(f"{rule.code}  {rule.name}: {rule.description}")
         return 0
 
@@ -60,24 +77,60 @@ def main(argv: Optional[list] = None, out=print) -> int:
     if not paths:
         paths = [str(Path(__file__).resolve().parent.parent)]
 
-    findings, errors = run_lint(paths)
-    for e in errors:
-        out(f"error: {e}")
+    result = run_project(paths, rules=rules, project_rules=project_rules,
+                         cache_path=Path(args.cache) if args.cache
+                         else None)
+    findings, errors = result.findings, result.errors
 
     baseline_path = Path(args.baseline) if args.baseline else Path(
         DEFAULT_BASELINE)
     if args.write_baseline:
+        for e in errors:
+            out(f"error: {e}")
         write_baseline(findings, baseline_path)
         out(f"wrote {len(findings)} finding(s) to {baseline_path}")
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
     new, suppressed, stale = apply_baseline(findings, baseline)
+
+    if args.format in ("json", "sarif"):
+        if args.format == "sarif":
+            from volsync_tpu.analysis.sarif import to_sarif
+
+            payload = to_sarif(new, errors, rules + project_rules)
+        else:
+            payload = {
+                "findings": [
+                    {"path": f.path, "line": f.line, "code": f.code,
+                     "message": f.message, "severity": f.severity}
+                    for f in new],
+                "errors": list(errors),
+                "analyzed": result.analyzed,
+                "total": result.total,
+            }
+        text = json.dumps(payload, indent=2, sort_keys=True)
+        if args.out:
+            Path(args.out).write_text(text + "\n", encoding="utf-8")
+            out(f"wrote {args.format} report to {args.out} "
+                f"({len(new)} finding(s))")
+        else:
+            out(text)
+        if args.cache:
+            out(f"cache: analyzed {len(result.analyzed)} of "
+                f"{result.total} file(s)")
+        return 1 if (new or errors) else 0
+
+    for e in errors:
+        out(f"error: {e}")
     for f in new:
         out(f.render())
     for k in stale:
         out(f"stale baseline entry (fixed? regenerate with "
             f"--write-baseline): {k}")
+    if args.cache:
+        out(f"cache: analyzed {len(result.analyzed)} of "
+            f"{result.total} file(s)")
     if new or errors:
         out(f"{len(new)} new finding(s), {suppressed} baselined, "
             f"{len(errors)} file error(s)")

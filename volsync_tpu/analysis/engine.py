@@ -44,6 +44,7 @@ class Finding:
     line: int
     code: str
     message: str
+    severity: str = "warning"  # error | warning | note (SARIF levels)
 
     def render(self) -> str:
         return f"{self.path}:{self.line} {self.code} {self.message}"
@@ -109,32 +110,158 @@ def _suppressed(ctx: FileContext, finding: Finding) -> bool:
     return finding.code in {c.strip() for c in codes.split(",")}
 
 
+@dataclass
+class LintResult:
+    """What a project run produced, plus how much work it did — the
+    `analyzed` list is what the incremental-cache acceptance criteria
+    are stated against (warm run: empty; single edit: the file plus
+    its reverse dependencies)."""
+
+    findings: list  # list[Finding]
+    errors: list  # list[str]
+    analyzed: list  # relpaths (re-)analyzed this run
+    total: int  # files considered
+
+
 def run_lint(paths: Iterable[str],
              rules: Optional[list] = None) -> tuple[list[Finding], list[str]]:
     """Lint ``paths`` -> (findings, errors). ``errors`` are files that
     failed to read/parse — reported, and they fail the run (a syntax
     error must not read as 'clean')."""
+    res = run_project(paths, rules=rules)
+    return res.findings, res.errors
+
+
+def _severity_of(f: Finding) -> str:
+    return getattr(f, "severity", "warning") or "warning"
+
+
+def run_project(paths: Iterable[str],
+                rules: Optional[list] = None,
+                project_rules: Optional[list] = None,
+                cache_path: Optional[Path] = None) -> LintResult:
+    """Project-wide lint: per-file rules plus the interprocedural
+    rules (callgraph + dataflow), with optional content-hash
+    incremental caching.
+
+    With ``cache_path`` and an unchanged tree, findings are served
+    entirely from the cache and no file is parsed. When files changed,
+    the dirty set is the changed files plus their transitive reverse
+    import dependencies; everything is re-parsed (the call graph is
+    global) but findings are refreshed only for dirty files and served
+    from cache for the rest.
+    """
+    from volsync_tpu.analysis import cache as cache_mod
+
     if rules is None:
         from volsync_tpu.analysis.rules import default_rules
 
         rules = default_rules()
-    findings: list[Finding] = []
+    if project_rules is None:
+        from volsync_tpu.analysis.iprules import default_project_rules
+
+        project_rules = default_project_rules()
+
     errors: list[str] = []
+    blobs: list[tuple[Path, str, bytes]] = []  # (path, relpath, bytes)
+    seen: set[str] = set()
     for path in iter_py_files(paths):
-        relpath = path.as_posix()
+        # anchor at the cwd when possible: scope decisions and cache
+        # keys must not depend on where the checkout lives (an absolute
+        # /root/repo/bench.py must not inherit a 'repo' scope dir)
         try:
-            source = path.read_text(encoding="utf-8")
+            relpath = path.relative_to(Path.cwd()).as_posix()
+        except ValueError:
+            relpath = path.as_posix()
+        if relpath in seen:
+            continue
+        seen.add(relpath)
+        try:
+            blobs.append((path, relpath, path.read_bytes()))
+        except OSError as e:
+            errors.append(f"{relpath}: {e}")
+
+    signature = cache_mod.rules_signature(rules, project_rules)
+    cached = (cache_mod.load_cache(cache_path, signature)
+              if cache_path else None)
+    hashes = {relpath: cache_mod.content_hash(data)
+              for _, relpath, data in blobs}
+
+    if cached is not None:
+        changed = {rp for rp in hashes
+                   if cached.get(rp, {}).get("hash") != hashes[rp]}
+        removed = set(cached) - set(hashes)
+        if not changed and not removed:
+            findings = [
+                Finding(rp, int(line), code, msg, severity=sev)
+                for rp, entry in cached.items()
+                for line, code, msg, sev in entry.get("findings", [])]
+            findings.sort(key=lambda f: (f.path, f.line, f.code))
+            return LintResult(findings, errors, [], len(blobs))
+    else:
+        changed = set(hashes)
+        removed = set()
+
+    # parse everything: interprocedural rules need the whole project
+    contexts: list[FileContext] = []
+    parsed: set[str] = set()
+    for path, relpath, data in blobs:
+        try:
+            source = data.decode("utf-8")
             tree = ast.parse(source, filename=str(path))
-        except (OSError, SyntaxError, ValueError) as e:
+        except (SyntaxError, ValueError) as e:
             errors.append(f"{relpath}: {e}")
             continue
-        ctx = FileContext(path, relpath, source, tree)
+        contexts.append(FileContext(path, relpath, source, tree))
+        parsed.add(relpath)
+
+    from volsync_tpu.analysis.callgraph import build_index
+
+    index = build_index(contexts)
+    deps = index.file_deps()
+    dirty = cache_mod.dirty_closure(changed & parsed, removed, deps)
+    dirty &= parsed
+
+    by_ctx = {ctx.relpath: ctx for ctx in contexts}
+    fresh: dict[str, list[Finding]] = {rp: [] for rp in dirty}
+    for relpath in sorted(dirty):
+        ctx = by_ctx[relpath]
         for rule in rules:
             for f in rule.check(ctx):
                 if not _suppressed(ctx, f):
-                    findings.append(f)
+                    fresh[relpath].append(f)
+    for rule in project_rules:
+        for f in rule.check_project(index):
+            ctx = by_ctx.get(f.path)
+            if f.path in dirty and ctx is not None:
+                if not _suppressed(ctx, f):
+                    fresh[f.path].append(f)
+
+    findings: list[Finding] = []
+    new_cache: dict[str, dict] = {}
+    for relpath in sorted(parsed):
+        if relpath in dirty:
+            file_findings = fresh.get(relpath, [])
+        else:
+            file_findings = [
+                Finding(relpath, int(line), code, msg, severity=sev)
+                for line, code, msg, sev in
+                (cached or {}).get(relpath, {}).get("findings", [])]
+        findings.extend(file_findings)
+        new_cache[relpath] = {
+            "hash": hashes[relpath],
+            "deps": sorted(deps.get(relpath, ())),
+            "findings": [[f.line, f.code, f.message, _severity_of(f)]
+                         for f in sorted(
+                             file_findings,
+                             key=lambda f: (f.line, f.code, f.message))],
+        }
+
+    if cache_path is not None and not errors:
+        cache_mod.save_cache(cache_path, signature, new_cache)
+
     findings.sort(key=lambda f: (f.path, f.line, f.code))
-    return findings, errors
+    return LintResult(findings, errors, sorted(dirty), len(blobs))
 
 
 # -- baseline ---------------------------------------------------------------

@@ -1,0 +1,81 @@
+"""SARIF 2.1.0 emission for `volsync lint` findings.
+
+Minimal but valid static-analysis result interchange: one run, one
+tool (`volsync-lint`), a rule catalogue with default severity levels,
+and one result per finding with a physical location. Unparsable files
+surface as tool-execution notifications so a syntax error cannot read
+as "clean" in a SARIF-consuming CI gate either (the CLI still exits
+nonzero on them).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+# Finding severities map 1:1 onto SARIF levels.
+_LEVELS = {"error": "error", "warning": "warning", "note": "note"}
+
+
+def _rule_descriptor(rule) -> dict:
+    return {
+        "id": rule.code,
+        "name": getattr(rule, "name", rule.code),
+        "shortDescription": {"text": getattr(rule, "description",
+                                             rule.code)},
+        "defaultConfiguration": {
+            "level": _LEVELS.get(getattr(rule, "severity", "warning"),
+                                 "warning"),
+        },
+    }
+
+
+def to_sarif(findings: Iterable, errors: Iterable[str],
+             rules: Optional[list] = None) -> dict:
+    rules = rules or []
+    rule_ids = [r.code for r in rules]
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.code,
+            "level": _LEVELS.get(getattr(f, "severity", "warning"),
+                                 "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line},
+                },
+            }],
+        }
+        if f.code in rule_ids:
+            res["ruleIndex"] = rule_ids.index(f.code)
+        results.append(res)
+    notifications = [
+        {"level": "error", "message": {"text": err}} for err in errors]
+    run = {
+        "tool": {
+            "driver": {
+                "name": "volsync-lint",
+                "informationUri":
+                    "https://github.com/RobotSail/volsync",
+                "rules": [_rule_descriptor(r) for r in rules],
+            },
+        },
+        "results": results,
+    }
+    if notifications:
+        run["invocations"] = [{
+            "executionSuccessful": False,
+            "toolExecutionNotifications": notifications,
+        }]
+    else:
+        run["invocations"] = [{"executionSuccessful": True}]
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [run],
+    }

@@ -485,7 +485,7 @@ class SyncthingDaemon:
             except OSError:
                 return
             threading.Thread(target=handler, args=(conn,),
-                             daemon=True).start()
+                             name="st-conn", daemon=True).start()
         server.close()
 
     def _handle_control(self, conn):

