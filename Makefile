@@ -4,8 +4,10 @@
 
 .PHONY: lint test static-check clean-lint
 
-# Cached SARIF lint over the whole tree (package + scripts/ + bench.py).
-# Warm runs re-analyze zero files; see docs/development.md.
+# Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
+# all rule families, VL001-VL005 per-file + VL101-VL104 interprocedural
+# + VL201-VL205 shape/dtype abstract interpretation, no baseline. Warm
+# runs re-analyze zero files; see docs/development.md.
 lint:
 	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
 	    --no-baseline --format sarif --out lint.sarif --cache .lint-cache

@@ -2,7 +2,10 @@
 # Local static-analysis + concurrency gate (docs/development.md).
 #
 #   1. `volsync lint` over the whole tree — package, scripts/ and
-#      bench.py — must be clean with no baseline
+#      bench.py — must be clean with no baseline, with every rule
+#      family enabled: the per-file VL001-VL005 checks, the
+#      interprocedural VL101-VL104 family, and the VL201-VL205
+#      shape/dtype abstract interpreter
 #      (tests/test_analysis.py enforces the same in tier-1). Emits a
 #      SARIF 2.1.0 report to lint.sarif for CI upload and uses the
 #      content-hash incremental cache (.lint-cache): a warm run

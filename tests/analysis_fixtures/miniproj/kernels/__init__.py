@@ -1,0 +1,2 @@
+"""Shape/dtype rule fixtures (VL201-VL205): one seeded true positive
+and one clean twin per rule. Parsed only, never imported."""

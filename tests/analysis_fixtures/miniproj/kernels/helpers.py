@@ -1,0 +1,13 @@
+"""Hash-state helpers for the VL202 interprocedural fixture: ``mix``
+adds a strong int32 step to uint32 hash state (the silent int64
+promotion the rule exists for); ``mix_ok`` casts explicitly. Parsed
+only, never imported."""
+import jax.numpy as jnp
+
+
+def mix(h, step):
+    return h * 33 + step  # MARK: vl202-sink
+
+
+def mix_ok(h, step):
+    return h * jnp.uint32(33) + step.astype(jnp.uint32)

@@ -1,0 +1,71 @@
+"""Seeded VL201-VL205 true positives, each next to a clean twin the
+rules must stay silent on. Parsed only, never imported."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.lax import psum
+
+from miniproj.kernels.helpers import mix, mix_ok
+from miniproj.parallel.mesh import SEQ_AXIS
+
+
+def vl201_bad():
+    a = jnp.zeros((4, 8), dtype=jnp.uint32)
+    b = jnp.ones((4, 7), dtype=jnp.uint32)
+    return a + b  # MARK: vl201-bad
+
+
+def vl201_ok():
+    a = jnp.zeros((4, 8), dtype=jnp.uint32)
+    b = jnp.ones((4, 8), dtype=jnp.uint32)
+    return a + b
+
+
+def vl202_bad():
+    h = jnp.zeros((128,), dtype=jnp.uint32)
+    step = jnp.arange(128, dtype=jnp.int32)
+    return mix(h, step)  # MARK: vl202-bad
+
+
+def vl202_ok():
+    h = jnp.zeros((128,), dtype=jnp.uint32)
+    step = jnp.arange(128, dtype=jnp.int32)
+    return mix_ok(h, step)
+
+
+def vl203_bad():
+    def body(c, x):
+        return c + 0.5, x
+
+    init = jnp.zeros((8,), dtype=jnp.int32)
+    xs = jnp.zeros((16, 8), dtype=jnp.int32)
+    return lax.scan(body, init, xs)  # MARK: vl203-bad
+
+
+def vl203_ok():
+    def body(c, x):
+        return c + 1, x
+
+    init = jnp.zeros((8,), dtype=jnp.int32)
+    xs = jnp.zeros((16, 8), dtype=jnp.int32)
+    return lax.scan(body, init, xs)
+
+
+def _pair(a, b):
+    return a + b
+
+
+def vl204_bad(x, y):
+    return jax.vmap(_pair, in_axes=(0, 0, 0))(x, y)  # MARK: vl204-bad
+
+
+def vl204_ok(x, y):
+    return jax.vmap(_pair, in_axes=(0, 0))(x, y)
+
+
+def vl205_bad(x):
+    return psum(x, "sq")  # MARK: vl205-bad
+
+
+def vl205_ok(x):
+    return psum(x, SEQ_AXIS)

@@ -1,0 +1,1 @@
+"""Mesh fixture package for the VL205 axis-name rule."""

@@ -1,0 +1,10 @@
+"""Declared mesh axes the VL205 rule checks against. Parsed only,
+never imported."""
+from jax.sharding import Mesh
+
+WAVE_AXIS = "wave"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(devices):
+    return Mesh(devices, (WAVE_AXIS, SEQ_AXIS))

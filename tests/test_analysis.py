@@ -218,8 +218,10 @@ def test_vl104_interprocedural_taint_fixture():
     assert "tracer-derived" in derived.message and "'z'" in derived.message
     direct = by_line[_mark_line(kern, "taint-direct")]
     assert "decide(" in direct.message
-    # nothing else fires on the fixture package
-    assert {f.code for f in res.findings} == {"VL101", "VL104"}
+    # nothing else fires on the fixture package beyond the seeded
+    # VL2xx shape/dtype bugs (asserted in test_analysis_shapes.py)
+    assert {f.code for f in res.findings} == {
+        "VL101", "VL104", "VL201", "VL202", "VL203", "VL204", "VL205"}
 
 
 def test_vl101_regions_and_comment_above_suppression(tmp_path):

@@ -1,0 +1,255 @@
+"""The shape/dtype abstract interpreter analyzed: the promotion and
+broadcasting lattice (absdomain), the five seeded VL201-VL205 bugs in
+``analysis_fixtures/miniproj/kernels`` (each with a clean twin the
+rules must stay silent on), the interprocedural hop chain, finding
+spans in SARIF regions, the ``--select``/``--ignore`` CLI filters, and
+shape summaries riding the incremental cache."""
+
+import json
+from pathlib import Path
+
+from volsync_tpu.analysis import absdomain as D
+from volsync_tpu.analysis.cli import filter_rules, main as lint_main
+from volsync_tpu.analysis.engine import run_project
+from volsync_tpu.analysis.shapes import default_shape_rules
+
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+KERN = FIXTURES / "miniproj" / "kernels" / "kern.py"
+
+
+def _mark_line(path: Path, marker: str) -> int:
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        if f"MARK: {marker}" in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in {path}")
+
+
+def _miniproj_vl2():
+    res = run_project([str(FIXTURES / "miniproj")])
+    assert res.errors == []
+    return [f for f in res.findings if f.code.startswith("VL2")]
+
+
+# -- abstract domain --------------------------------------------------------
+
+def test_promotion_lattice():
+    # a weak Python int adapts to uint32 instead of promoting it
+    assert D.promote("uint32", False, "int32", True) == ("uint32", False)
+    # strong int32 vs uint32 crosses the signedness boundary
+    assert D.promote("uint32", False, "int32", False) == ("int64", False)
+    # uint64 vs int64 falls off the integer lattice entirely
+    assert D.promote("uint64", False, "int64", False) == ("float64", False)
+    # a weak float meeting any integer floats the result
+    assert D.promote("uint32", False, "float32", True) == ("float32", False)
+    # equal-width float kinds promote to float32
+    assert D.promote("float16", False, "bfloat16", False) == (
+        "float32", False)
+    # Unknown in -> Unknown out, never a guess
+    assert D.promote(None, False, "uint32", False) == (None, False)
+
+
+def test_broadcast_three_valued():
+    # concrete conflict is the ONLY reportable case
+    shape, conflict = D.broadcast_shapes((4, 8), (4, 7))
+    assert conflict == (8, 7, 0)
+    # a 1 broadcasts
+    shape, conflict = D.broadcast_shapes((4, 1), (4, 7))
+    assert conflict is None and shape == (4, 7)
+    # symbolic vs concrete stays silent (Unknown dim in the result)
+    shape, conflict = D.broadcast_shapes((D.sym("n"), 8), (3, 8))
+    assert conflict is None and shape == (None, 8)
+    # unknown rank stays silent
+    assert D.broadcast_shapes(None, (4,)) == (None, None)
+
+
+def test_dim_arithmetic_structural_equality():
+    n = D.sym("n")
+    assert D.dim_binop("add", n, 1) == D.dim_binop("add", n, 1)
+    assert D.dim_binop("add", 2, 3) == 5
+    assert D.dim_binop("add", n, 0) == n
+    assert D.dim_binop("floordiv", n, None) is None
+
+
+# -- the five rules over the committed fixture ------------------------------
+
+def test_vl201_shape_mismatch_fixture():
+    (f,) = [f for f in _miniproj_vl2() if f.code == "VL201"]
+    assert f.path.endswith("kernels/kern.py")
+    assert f.line == _mark_line(KERN, "vl201-bad")
+    assert "(4, 8)" in f.message and "(4, 7)" in f.message
+    assert f.severity == "error"
+
+
+def test_vl202_promotion_with_hop_chain():
+    (f,) = [f for f in _miniproj_vl2() if f.code == "VL202"]
+    # reported at the depth-0 call site, with the sink location and
+    # the interprocedural hop chain in the message
+    assert f.line == _mark_line(KERN, "vl202-bad")
+    assert "uint32 -> int64" in f.message
+    assert "via mix()" in f.message
+    helpers = FIXTURES / "miniproj" / "kernels" / "helpers.py"
+    sink_line = _mark_line(helpers, "vl202-sink")
+    assert f"helpers.py:{sink_line}" in f.message
+    assert f.severity == "warning"
+
+
+def test_vl203_carry_drift_fixture():
+    (f,) = [f for f in _miniproj_vl2() if f.code == "VL203"]
+    assert f.line == _mark_line(KERN, "vl203-bad")
+    assert "int32" in f.message and "float32" in f.message
+    assert f.severity == "error"
+
+
+def test_vl204_vmap_arity_fixture():
+    (f,) = [f for f in _miniproj_vl2() if f.code == "VL204"]
+    assert f.line == _mark_line(KERN, "vl204-bad")
+    assert "3 entries" in f.message and "2 arguments" in f.message
+
+
+def test_vl205_mesh_axis_fixture():
+    (f,) = [f for f in _miniproj_vl2() if f.code == "VL205"]
+    assert f.line == _mark_line(KERN, "vl205-bad")
+    assert "'sq'" in f.message
+    assert "seq" in f.message and "wave" in f.message
+
+
+def test_clean_twins_stay_silent():
+    lines = {f.line for f in _miniproj_vl2()}
+    bad = {_mark_line(KERN, f"vl20{i}-bad") for i in range(1, 6)}
+    assert lines == bad  # exactly the seeded sites, nothing else
+
+
+def test_inline_suppression(tmp_path):
+    mod = tmp_path / "k.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "def f():\n"
+        "    a = jnp.zeros((4, 8), dtype=jnp.uint32)\n"
+        "    b = jnp.ones((4, 7), dtype=jnp.uint32)\n"
+        "    return a + b  # lint: ignore[VL201] — exercised in a test\n")
+    res = run_project([str(mod)])
+    assert [f for f in res.findings if f.code == "VL201"] == []
+
+
+# -- finding spans / SARIF regions ------------------------------------------
+
+def test_vl201_finding_carries_span():
+    (f,) = [f for f in _miniproj_vl2() if f.code == "VL201"]
+    src_line = KERN.read_text().splitlines()[f.line - 1]
+    # span covers exactly the `a + b` expression (1-based, end
+    # exclusive at end_col)
+    assert f.col == src_line.index("a + b") + 1
+    assert f.end_line == f.line
+    assert src_line[f.col - 1:f.end_col - 1] == "a + b"
+
+
+def test_sarif_end_regions(tmp_path):
+    mod = tmp_path / "k.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "def f():\n"
+        "    a = jnp.zeros((4, 8), dtype=jnp.uint32)\n"
+        "    b = jnp.ones((4, 7), dtype=jnp.uint32)\n"
+        "    return a + b\n")
+    out_file = tmp_path / "lint.sarif"
+    rc = lint_main([str(mod), "--no-baseline", "--format", "sarif",
+                    "--out", str(out_file)], out=lambda *_: None)
+    assert rc == 1
+    doc = json.loads(out_file.read_text())
+    (res,) = [r for r in doc["runs"][0]["results"]
+              if r["ruleId"] == "VL201"]
+    region = res["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] == 5
+    assert region["endLine"] == 5
+    src = mod.read_text().splitlines()[4]
+    assert src[region["startColumn"] - 1:region["endColumn"] - 1] \
+        == "a + b"
+    rule_ids = [r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]]
+    for code in ("VL201", "VL202", "VL203", "VL204", "VL205"):
+        assert code in rule_ids
+
+
+# -- --select / --ignore ----------------------------------------------------
+
+def test_filter_rules_by_prefix():
+    rules = default_shape_rules()
+    assert [r.code for r in filter_rules(rules, ["VL20"], None)] == [
+        "VL201", "VL202", "VL203", "VL204", "VL205"]
+    assert [r.code for r in filter_rules(rules, None, ["VL202"])] == [
+        "VL201", "VL203", "VL204", "VL205"]
+    assert filter_rules(rules, ["VL9"], None) == []
+
+
+def test_cli_select_and_ignore(tmp_path):
+    out_file = tmp_path / "report.json"
+    rc = lint_main([str(FIXTURES / "miniproj"), "--no-baseline",
+                    "--select", "VL2", "--format", "json",
+                    "--out", str(out_file)], out=lambda *_: None)
+    assert rc == 1
+    codes = {f["code"]
+             for f in json.loads(out_file.read_text())["findings"]}
+    assert codes == {"VL201", "VL202", "VL203", "VL204", "VL205"}
+
+    rc = lint_main([str(FIXTURES / "miniproj"), "--no-baseline",
+                    "--ignore", "VL2,VL101,VL104", "--format", "json",
+                    "--out", str(out_file)], out=lambda *_: None)
+    assert rc == 0
+    assert json.loads(out_file.read_text())["findings"] == []
+
+
+def test_cli_list_rules_includes_vl2xx():
+    lines = []
+    rc = lint_main(["--list-rules"], out=lines.append)
+    assert rc == 0
+    text = "\n".join(lines)
+    for code in ("VL201", "VL202", "VL203", "VL204", "VL205"):
+        assert code in text
+
+
+# -- shape summaries in the incremental cache -------------------------------
+
+def test_shape_summary_cache_invalidation(tmp_path):
+    helpers = tmp_path / "helpers.py"
+    kern = tmp_path / "kern.py"
+    other = tmp_path / "other.py"
+    helpers.write_text(
+        "import jax.numpy as jnp\n"
+        "def table():\n"
+        "    return jnp.zeros((4, 8), dtype=jnp.uint32)\n")
+    kern.write_text(
+        "import jax.numpy as jnp\n"
+        "import helpers\n"
+        "def use():\n"
+        "    return helpers.table() + jnp.uint32(1)\n")
+    other.write_text(
+        "import jax.numpy as jnp\n"
+        "def solo():\n"
+        "    return jnp.ones((2,), dtype=jnp.int32)\n")
+    cache = tmp_path / ".lint-cache"
+
+    cold = run_project([str(tmp_path)], cache_path=cache)
+    assert cold.errors == []
+    assert len(cold.analyzed) == 3
+
+    # the cache carries a per-file {qualname: summary} snapshot
+    payload = json.loads(cache.read_text())
+    entries = payload["files"] if "files" in payload else payload
+    entry = next(v for k, v in entries.items()
+                 if k.endswith("helpers.py"))
+    assert entry["shapes"]["helpers.table"] == "uint32(4, 8)"
+
+    warm = run_project([str(tmp_path)], cache_path=cache)
+    assert warm.analyzed == []
+
+    # editing the summary source re-analyzes the helper AND its
+    # reverse dependency, but NOT the unrelated module
+    helpers.write_text(helpers.read_text().replace("(4, 8)", "(8, 8)"))
+    edited = run_project([str(tmp_path)], cache_path=cache)
+    assert sorted(Path(p).name for p in edited.analyzed) == [
+        "helpers.py", "kern.py"]
+
+    payload = json.loads(cache.read_text())
+    entries = payload["files"] if "files" in payload else payload
+    entry = next(v for k, v in entries.items()
+                 if k.endswith("helpers.py"))
+    assert entry["shapes"]["helpers.table"] == "uint32(8, 8)"

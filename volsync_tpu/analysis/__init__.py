@@ -12,8 +12,14 @@ Two halves:
   over the project call graph (``callgraph``/``dataflow``): no
   blocking I/O under a lockcheck lock, thread/executor lifecycle,
   exception-path resource leaks, tracer taint through helper calls
-  (VL101-VL104). SARIF/JSON output and a content-hash incremental
-  cache live in ``sarif``/``cache``.
+  (VL101-VL104); plus a shape/dtype abstract interpreter over the same
+  call graph (``shapes``/``absdomain``): statically incompatible
+  shapes, implicit dtype promotion out of uint32 hash arithmetic,
+  ``lax.scan`` carry drift, ``vmap`` axis arity, and mesh axis names
+  vs ``parallel/mesh.py`` (VL201-VL205), with interprocedural shape
+  summaries. SARIF/JSON output (full source spans) and a content-hash
+  incremental cache live in ``sarif``/``cache``; ``--select`` /
+  ``--ignore`` stage rule families by code prefix.
 
 * ``volsync_tpu.analysis.lockcheck`` — a debug-flag
   (``VOLSYNC_TPU_LOCKCHECK=1``) runtime detector that records the

@@ -1,0 +1,269 @@
+"""Abstract domain for the shape/dtype interpreter (analysis/shapes.py).
+
+The lattice is deliberately three-valued everywhere: a property is
+either *known* (a concrete Python value), *symbolic* (a structural
+token derived from an unknown quantity, so two occurrences of the same
+expression compare equal), or *Unknown* (``None`` — no information).
+Every rule built on top of this domain only fires on the *known*
+tier: an Unknown or merely-symbolic disagreement can suppress a
+finding but can never create one — the same false-negatives-only
+bargain the per-file rules and the call-graph resolver make.
+
+Dims
+----
+A dimension is ``int`` (concrete), a structural tuple like
+``("add", ("sym", 3), 1)`` (symbolic — interned by construction so
+``n + 1`` from two sites compares equal), or ``None`` (unknown).
+
+Dtypes
+------
+Dtypes are canonical strings (``"uint32"``, ``"float32"``, ``"bool"``)
+plus a *weak* flag mirroring JAX's weak-type promotion: a Python
+scalar literal is weakly typed and adapts to the other operand's
+dtype instead of promoting it — ``uint32_arr + 2`` stays ``uint32``,
+while ``uint32_arr + int32_arr`` crosses the signedness boundary.
+``promote`` follows the JAX lattice *before* 32-bit canonicalization
+(``uint32 + int32 -> int64``): for lint purposes what matters is that
+the result left ``uint32``, not which wider type it landed on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# -- dimensions -------------------------------------------------------------
+
+# Dim = int | structural tuple | None (unknown)
+
+
+def is_conc(d) -> bool:
+    """Concrete dimension (a real int; bool is a Python int subtype
+    and must not slip through)."""
+    return isinstance(d, int) and not isinstance(d, bool)
+
+
+def sym(token) -> tuple:
+    """Opaque symbolic dim from a hashable token (the interpreter
+    uses per-run counters / qualnames, so runs stay deterministic)."""
+    return ("sym", token)
+
+
+def dim_binop(op: str, a, b):
+    """Structural arithmetic on dims. Concrete operands fold; anything
+    touching Unknown stays Unknown; otherwise the expression tree is
+    the value, so equal expressions compare equal."""
+    if a is None or b is None:
+        return None
+    if is_conc(a) and is_conc(b):
+        try:
+            if op == "add":
+                return a + b
+            if op == "sub":
+                return a - b
+            if op == "mul":
+                return a * b
+            if op == "floordiv":
+                return a // b
+            if op == "mod":
+                return a % b
+        except (ZeroDivisionError, OverflowError):
+            return None
+        return None
+    # tiny normalizations keep common slice arithmetic comparable
+    if op == "add" and b == 0:
+        return a
+    if op in ("add", "mul") and a == 0 and op == "add":
+        return b
+    if op == "sub" and b == 0:
+        return a
+    if op == "mul" and (a == 1 or b == 1):
+        return b if a == 1 else a
+    return (op, a, b)
+
+
+def join_dim(a, b):
+    return a if a == b else None
+
+
+# -- dtypes -----------------------------------------------------------------
+
+_CANON = {
+    "bool_": "bool",
+    "bool": "bool",
+    "uint8": "uint8", "uint16": "uint16",
+    "uint32": "uint32", "uint64": "uint64",
+    "int8": "int8", "int16": "int16",
+    "int32": "int32", "int64": "int64",
+    "float16": "float16", "bfloat16": "bfloat16",
+    "float32": "float32", "float64": "float64",
+    "complex64": "complex64", "complex128": "complex128",
+}
+
+KIND_BOOL, KIND_UINT, KIND_INT, KIND_FLOAT, KIND_COMPLEX = range(5)
+
+
+def canon_dtype(name: str) -> Optional[str]:
+    """Canonical dtype string or None for anything exotic (``">u4"``
+    byte-order strings and friends stay Unknown on purpose)."""
+    return _CANON.get(name)
+
+
+def kind(dtype: str) -> int:
+    if dtype == "bool":
+        return KIND_BOOL
+    if dtype.startswith("uint"):
+        return KIND_UINT
+    if dtype.startswith("int"):
+        return KIND_INT
+    if dtype.startswith("float") or dtype == "bfloat16":
+        return KIND_FLOAT
+    return KIND_COMPLEX
+
+
+def width(dtype: str) -> int:
+    digits = "".join(c for c in dtype if c.isdigit())
+    return int(digits) if digits else 8  # bool
+
+
+def is_uint(dtype: Optional[str]) -> bool:
+    return bool(dtype) and dtype.startswith("uint")
+
+
+def promote(d1: Optional[str], w1: bool,
+            d2: Optional[str], w2: bool) -> Tuple[Optional[str], bool]:
+    """JAX-style binary result type. Unknown in -> Unknown out."""
+    if d1 is None or d2 is None:
+        return None, False
+    if d1 == d2:
+        return d1, w1 and w2
+    k1, k2 = kind(d1), kind(d2)
+    if w1 != w2:
+        # exactly one weak operand: a Python scalar adapts to the
+        # strong dtype unless it is a float meeting an integer
+        weak_d, weak_k = (d1, k1) if w1 else (d2, k2)
+        strong_d, strong_k = (d2, k2) if w1 else (d1, k1)
+        if weak_k == KIND_FLOAT and strong_k < KIND_FLOAT:
+            return "float32", False
+        if weak_k == KIND_INT and strong_k <= KIND_INT:
+            return strong_d, False  # weak int never promotes an int/uint
+        if weak_k <= strong_k:
+            return strong_d, False
+        return None, False
+    if w1 and w2:
+        return (d1 if k1 >= k2 else d2), True
+    # both strong
+    if k1 == KIND_BOOL:
+        return d2, False
+    if k2 == KIND_BOOL:
+        return d1, False
+    if k1 == k2:
+        if width(d1) == width(d2):  # float16 vs bfloat16
+            return "float32", False
+        return (d1 if width(d1) > width(d2) else d2), False
+    if KIND_COMPLEX in (k1, k2):
+        return "complex64", False
+    if KIND_FLOAT in (k1, k2):
+        return (d1 if k1 == KIND_FLOAT else d2), False
+    # uint vs int: the signed side wins when strictly wider, else the
+    # next-wider signed integer (uint64 vs int64 falls off to float64)
+    ud, sd = (d1, d2) if k1 == KIND_UINT else (d2, d1)
+    if width(sd) > width(ud):
+        return sd, False
+    nw = width(ud) * 2
+    return (f"int{nw}" if nw <= 64 else "float64"), False
+
+
+def join_dtype(d1: Optional[str], w1: bool,
+               d2: Optional[str], w2: bool) -> Tuple[Optional[str], bool]:
+    if d1 == d2:
+        return d1, w1 and w2
+    return None, False
+
+
+# -- abstract arrays --------------------------------------------------------
+
+@dataclass(frozen=True)
+class AbsArray:
+    """An array (or scalar: ``shape == ()``) in the abstract domain.
+
+    ``shape`` is a tuple of dims or ``None`` for unknown rank;
+    ``dtype`` a canonical string or ``None``; ``weak`` mirrors JAX's
+    weak-type flag for Python scalar literals.
+    """
+
+    shape: Optional[tuple]
+    dtype: Optional[str]
+    weak: bool = False
+
+    def rank(self) -> Optional[int]:
+        return None if self.shape is None else len(self.shape)
+
+
+UNKNOWN_ARRAY = AbsArray(None, None)
+
+
+def shape_str(shape: Optional[tuple]) -> str:
+    if shape is None:
+        return "(?)"
+
+    def one(d):
+        if is_conc(d):
+            return str(d)
+        return "?" if d is None else "s"
+
+    return "(" + ", ".join(one(d) for d in shape) + ("," if len(shape) == 1
+                                                     else "") + ")"
+
+
+def broadcast_shapes(a: Optional[tuple],
+                     b: Optional[tuple]) -> Tuple[Optional[tuple],
+                                                  Optional[tuple]]:
+    """NumPy broadcasting, three-valued.
+
+    Returns ``(result_shape, conflict)`` where ``conflict`` is
+    ``(dim_a, dim_b, axis_from_right)`` only when two CONCRETE dims
+    disagree and neither is 1 — the only case a rule may report.
+    Symbolic or unknown dims broadcast silently to Unknown.
+    """
+    if a is None or b is None:
+        return None, None
+    out = []
+    conflict = None
+    for i in range(1, max(len(a), len(b)) + 1):
+        da = a[-i] if i <= len(a) else 1
+        db = b[-i] if i <= len(b) else 1
+        if da == db:
+            out.append(da)
+        elif da == 1:
+            out.append(db)
+        elif db == 1:
+            out.append(da)
+        elif is_conc(da) and is_conc(db):
+            conflict = (da, db, i - 1)
+            out.append(None)
+        else:
+            out.append(None)  # symbolic vs anything: silent
+    return tuple(reversed(out)), conflict
+
+
+def numel(shape: Optional[tuple]):
+    if shape is None:
+        return None
+    n = 1
+    for d in shape:
+        if not is_conc(d):
+            return None
+        n *= d
+    return n
+
+
+def join_shape(a: Optional[tuple], b: Optional[tuple]) -> Optional[tuple]:
+    if a is None or b is None or len(a) != len(b):
+        return None
+    return tuple(join_dim(x, y) for x, y in zip(a, b))
+
+
+def join_array(a: AbsArray, b: AbsArray) -> AbsArray:
+    d, w = join_dtype(a.dtype, a.weak, b.dtype, b.weak)
+    return AbsArray(join_shape(a.shape, b.shape), d, w)

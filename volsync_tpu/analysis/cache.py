@@ -27,7 +27,7 @@ from typing import Optional
 CACHE_VERSION = 1
 # bump when rule logic changes in a way that should bust caches even
 # though rule codes stayed the same
-ANALYZER_REVISION = 1
+ANALYZER_REVISION = 2
 
 
 def content_hash(data: bytes) -> str:

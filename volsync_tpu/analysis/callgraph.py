@@ -1,7 +1,8 @@
 """Project-wide module/symbol resolver and call-graph builder.
 
 This is the substrate the interprocedural rules (analysis/iprules.py)
-stand on: it turns a set of parsed files (engine.FileContext) into a
+and the shape/dtype abstract interpreter (analysis/shapes.py) stand
+on: it turns a set of parsed files (engine.FileContext) into a
 ``ProjectIndex`` — modules with their import-alias tables, every
 function/method/nested-def with a stable qualname, class method tables
 with (single-level) base resolution, and one ``CallSite`` per call

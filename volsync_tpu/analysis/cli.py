@@ -27,7 +27,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="volsync lint",
         description="Repo-invariant AST lint for volsync-tpu "
                     "(per-file rules VL001-VL005, interprocedural "
-                    "rules VL101-VL104; see docs/development.md)")
+                    "rules VL101-VL104, shape/dtype rules "
+                    "VL201-VL205; see docs/development.md)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to lint (default: the installed "
@@ -55,19 +56,57 @@ def build_parser() -> argparse.ArgumentParser:
         "--cache", default=None, metavar="FILE",
         help="incremental cache file: re-analyze only changed files "
              "and their reverse import dependencies")
+    parser.add_argument(
+        "--select", default=None, metavar="PREFIXES",
+        help="comma-separated rule-code prefixes to run, e.g. "
+             "'VL2' or 'VL001,VL10' — everything else is skipped "
+             "(CI can stage a new rule family this way)")
+    parser.add_argument(
+        "--ignore", default=None, metavar="PREFIXES",
+        help="comma-separated rule-code prefixes to skip; applied "
+             "after --select")
     return parser
 
 
 def _all_rules():
     from volsync_tpu.analysis.iprules import default_project_rules
     from volsync_tpu.analysis.rules import default_rules
+    from volsync_tpu.analysis.shapes import default_shape_rules
 
-    return default_rules(), default_project_rules()
+    return default_rules(), default_project_rules() + default_shape_rules()
+
+
+def _split_prefixes(raw: Optional[str]) -> Optional[list]:
+    if raw is None:
+        return None
+    return [p.strip().upper() for p in raw.split(",") if p.strip()]
+
+
+def filter_rules(rules: list, select: Optional[list],
+                 ignore: Optional[list]) -> list:
+    """Keep rules whose code starts with a --select prefix (all, when
+    unset) and doesn't start with an --ignore prefix."""
+    out = []
+    for rule in rules:
+        code = rule.code
+        if select is not None and not any(code.startswith(p)
+                                          for p in select):
+            continue
+        if ignore is not None and any(code.startswith(p)
+                                      for p in ignore):
+            continue
+        out.append(rule)
+    return out
 
 
 def main(argv: Optional[list] = None, out=print) -> int:
     args = build_parser().parse_args(argv)
     rules, project_rules = _all_rules()
+    select = _split_prefixes(args.select)
+    ignore = _split_prefixes(args.ignore)
+    if select is not None or ignore is not None:
+        rules = filter_rules(rules, select, ignore)
+        project_rules = filter_rules(project_rules, select, ignore)
     if args.list_rules:
         for rule in rules + project_rules:
             out(f"{rule.code}  {rule.name}: {rule.description}")

@@ -45,12 +45,41 @@ class Finding:
     code: str
     message: str
     severity: str = "warning"  # error | warning | note (SARIF levels)
+    # optional source span (1-based; 0 = unknown) — SARIF region data
+    col: int = 0
+    end_line: int = 0
+    end_col: int = 0
 
     def render(self) -> str:
         return f"{self.path}:{self.line} {self.code} {self.message}"
 
     def baseline_key(self) -> str:
         return f"{self.path}:{self.code}:{self.message}"
+
+
+def finding_at(relpath: str, node: ast.AST, code: str, message: str,
+               severity: str = "warning") -> Finding:
+    """Finding carrying the full source span of ``node`` (ast column
+    offsets are 0-based; SARIF and editors are 1-based)."""
+    end_line = getattr(node, "end_lineno", None) or 0
+    end_col = getattr(node, "end_col_offset", None)
+    return Finding(
+        relpath, getattr(node, "lineno", 0), code, message,
+        severity=severity,
+        col=getattr(node, "col_offset", -1) + 1,
+        end_line=end_line,
+        end_col=0 if end_col is None else end_col + 1)
+
+
+def _finding_from_row(relpath: str, row: list) -> Finding:
+    """Rebuild a Finding from a cache row; rows written before the
+    span fields existed have 4 elements."""
+    line, code, msg, sev = row[0], row[1], row[2], row[3]
+    col, end_line, end_col = (row[4], row[5], row[6]) if len(row) >= 7 \
+        else (0, 0, 0)
+    return Finding(relpath, int(line), code, msg, severity=sev,
+                   col=int(col), end_line=int(end_line),
+                   end_col=int(end_col))
 
 
 class FileContext:
@@ -159,8 +188,9 @@ def run_project(paths: Iterable[str],
         rules = default_rules()
     if project_rules is None:
         from volsync_tpu.analysis.iprules import default_project_rules
+        from volsync_tpu.analysis.shapes import default_shape_rules
 
-        project_rules = default_project_rules()
+        project_rules = default_project_rules() + default_shape_rules()
 
     errors: list[str] = []
     blobs: list[tuple[Path, str, bytes]] = []  # (path, relpath, bytes)
@@ -193,9 +223,9 @@ def run_project(paths: Iterable[str],
         removed = set(cached) - set(hashes)
         if not changed and not removed:
             findings = [
-                Finding(rp, int(line), code, msg, severity=sev)
+                _finding_from_row(rp, row)
                 for rp, entry in cached.items()
-                for line, code, msg, sev in entry.get("findings", [])]
+                for row in entry.get("findings", [])]
             findings.sort(key=lambda f: (f.path, f.line, f.code))
             return LintResult(findings, errors, [], len(blobs))
     else:
@@ -237,24 +267,38 @@ def run_project(paths: Iterable[str],
                 if not _suppressed(ctx, f):
                     fresh[f.path].append(f)
 
+    # shape summaries ride the cache so a warm run can show them (and
+    # the cache tests can assert summary-edit invalidation) without
+    # re-running the interpreter; only computed when VL2xx rules ran
+    shape_sum: dict = {}
+    if any(str(getattr(r, "code", "")).startswith("VL2")
+           for r in project_rules):
+        from volsync_tpu.analysis.shapes import summaries_for
+
+        shape_sum = summaries_for(index)
+
     findings: list[Finding] = []
     new_cache: dict[str, dict] = {}
     for relpath in sorted(parsed):
+        old_entry = (cached or {}).get(relpath, {})
         if relpath in dirty:
             file_findings = fresh.get(relpath, [])
+            shapes_entry = shape_sum.get(relpath, {})
         else:
-            file_findings = [
-                Finding(relpath, int(line), code, msg, severity=sev)
-                for line, code, msg, sev in
-                (cached or {}).get(relpath, {}).get("findings", [])]
+            file_findings = [_finding_from_row(relpath, row)
+                             for row in old_entry.get("findings", [])]
+            shapes_entry = old_entry.get("shapes",
+                                         shape_sum.get(relpath, {}))
         findings.extend(file_findings)
         new_cache[relpath] = {
             "hash": hashes[relpath],
             "deps": sorted(deps.get(relpath, ())),
-            "findings": [[f.line, f.code, f.message, _severity_of(f)]
+            "findings": [[f.line, f.code, f.message, _severity_of(f),
+                          f.col, f.end_line, f.end_col]
                          for f in sorted(
                              file_findings,
                              key=lambda f: (f.line, f.code, f.message))],
+            "shapes": shapes_entry,
         }
 
     if cache_path is not None and not errors:

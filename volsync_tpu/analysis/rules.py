@@ -17,7 +17,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional
 
-from volsync_tpu.analysis.engine import FileContext, Finding
+from volsync_tpu.analysis.engine import FileContext, Finding, finding_at
 
 _BROAD_EXC = {"Exception", "BaseException"}
 
@@ -91,8 +91,8 @@ class EnvFlagRule:
                         and is_environ(node.comparators[0])):
                     key = volsync_key(node.left)
             if key is not None:
-                yield Finding(
-                    ctx.relpath, node.lineno, self.code,
+                yield finding_at(
+                    ctx.relpath, node, self.code,
                     f"read of {key!r} outside envflags.py — add/use an "
                     f"accessor in volsync_tpu/envflags.py")
 
@@ -123,8 +123,8 @@ class ImportGateRule:
                 shim = self.GATES.get(root)
                 if shim is None or ctx.in_module(shim):
                     continue
-                yield Finding(
-                    ctx.relpath, node.lineno, self.code,
+                yield finding_at(
+                    ctx.relpath, node, self.code,
                     f"import of {root!r} outside {shim} — route through "
                     f"the shim so its absence degrades instead of "
                     f"breaking imports")
@@ -168,8 +168,8 @@ class SilentExceptRule:
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if self._is_broad(node.type) and self._is_silent(node.body):
-                yield Finding(
-                    ctx.relpath, node.lineno, self.code,
+                yield finding_at(
+                    ctx.relpath, node, self.code,
                     "broad except swallows the exception silently — "
                     "re-raise, narrow the type, or log it "
                     "(`# lint: ignore[VL003]` with a reason if "
@@ -267,22 +267,22 @@ class TracerSafetyRule:
                             and len(node.args) == 1
                             and not isinstance(node.args[0], ast.Constant)
                             and self._traced_uses(node.args[0], traced)):
-                        yield Finding(
-                            ctx.relpath, node.lineno, self.code,
+                        yield finding_at(
+                            ctx.relpath, node, self.code,
                             f"{f.id}() on a traced value inside jit'd "
                             f"{fn.name}() — forces a host sync or fails "
                             f"at trace time")
                     elif (isinstance(f, ast.Attribute)
                           and f.attr in ("item", "tolist")):
-                        yield Finding(
-                            ctx.relpath, node.lineno, self.code,
+                        yield finding_at(
+                            ctx.relpath, node, self.code,
                             f".{f.attr}() inside jit'd {fn.name}() — "
                             f"host transfer of a traced value")
                 elif isinstance(node, (ast.If, ast.While)):
                     hot = self._traced_uses(node.test, traced)
                     if hot:
-                        yield Finding(
-                            ctx.relpath, node.lineno, self.code,
+                        yield finding_at(
+                            ctx.relpath, node, self.code,
                             f"Python branch on traced arg(s) "
                             f"{sorted(hot)} inside jit'd {fn.name}() — "
                             f"use lax.cond/lax.select")
@@ -323,8 +323,8 @@ class DirectLockRule:
             elif isinstance(f, ast.Name) and f.id in lock_names:
                 hit = f.id
             if hit:
-                yield Finding(
-                    ctx.relpath, node.lineno, self.code,
+                yield finding_at(
+                    ctx.relpath, node, self.code,
                     f"threading.{hit}() constructed directly — use "
                     f"analysis.lockcheck.make_{hit.lower()}(name) so "
                     f"VOLSYNC_TPU_LOCKCHECK can instrument it")

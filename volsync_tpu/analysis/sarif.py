@@ -33,6 +33,25 @@ def _rule_descriptor(rule) -> dict:
     }
 
 
+def _region(f) -> dict:
+    """Full-span region when the finding carries one (column/end data
+    is 1-based, 0 meaning unknown); point location otherwise, so
+    editor integrations highlight the whole offending expression."""
+    region = {"startLine": f.line}
+    col = getattr(f, "col", 0)
+    end_line = getattr(f, "end_line", 0)
+    end_col = getattr(f, "end_col", 0)
+    if col:
+        region["startColumn"] = col
+    if end_line:
+        region["endLine"] = end_line
+        # SARIF endColumn is exclusive; ours is the 1-based column just
+        # past the node, which matches ast's end_col_offset + 1
+        if end_col:
+            region["endColumn"] = end_col
+    return region
+
+
 def to_sarif(findings: Iterable, errors: Iterable[str],
              rules: Optional[list] = None) -> dict:
     rules = rules or []
@@ -47,7 +66,7 @@ def to_sarif(findings: Iterable, errors: Iterable[str],
             "locations": [{
                 "physicalLocation": {
                     "artifactLocation": {"uri": f.path},
-                    "region": {"startLine": f.line},
+                    "region": _region(f),
                 },
             }],
         }

@@ -353,7 +353,7 @@ def chunk_buffer(data, params: GearParams = DEFAULT_PARAMS,
         padded = (length + params.align - 1) // params.align * params.align
         buf = np.pad(np.asarray(data), (0, padded - length)) \
             if padded != length else np.asarray(data)
-        dev = jnp.asarray(buf)
+        dev = jnp.asarray(buf, dtype=jnp.uint8)
         cap = 4096
         while True:
             pos, flags, count = cdc_candidates_aligned(
@@ -367,7 +367,7 @@ def chunk_buffer(data, params: GearParams = DEFAULT_PARAMS,
         pos = np.asarray(pos)[:c]
         flags = np.asarray(flags)[:c]
         return select_boundaries(pos[flags], pos, length, params, eof=eof)
-    dev = jnp.asarray(data)
+    dev = jnp.asarray(data, dtype=jnp.uint8)
     # Expected candidate density is 2^-(bits-norm) for the lax mask; leave
     # generous headroom, and retry exactly if real data is denser.
     guess = max(1024, 8 * length // max(1, params.avg_size >> (params.norm_level + 1)))

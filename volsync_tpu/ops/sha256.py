@@ -102,7 +102,7 @@ def _compress_scan(state: jax.Array, block: jax.Array) -> jax.Array:
     16-word schedule window. The CPU path — XLA's CPU backend takes
     minutes to compile the unrolled form (CPU is tests/dry-runs only,
     where compile time matters and throughput doesn't)."""
-    K = jnp.asarray(_K)
+    K = jnp.asarray(_K, dtype=jnp.uint32)
     w0 = jnp.moveaxis(block, -1, 0)  # [16, ...] rolling schedule window
     abcdefgh = tuple(state[..., i] for i in range(8))
 
@@ -151,7 +151,7 @@ def sha256_blocks(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
     returns: [B, 8] uint32 digests.
     """
     B, N, _ = blocks.shape
-    state0 = jnp.broadcast_to(jnp.asarray(_H0), (B, 8))
+    state0 = jnp.broadcast_to(jnp.asarray(_H0, dtype=jnp.uint32), (B, 8))
     # XOR with a zero slice of the input so the carry inherits the input's
     # shard_map varying-axis metadata (scan requires carry-in == carry-out;
     # a constant init would be "unvarying" while the output varies).
@@ -304,7 +304,7 @@ def _sha256_rows(wb: jax.Array, rows0: jax.Array,
     """
     B = rows0.shape[0]
     nsteps = leaf_len // 64
-    state0 = jnp.broadcast_to(jnp.asarray(_H0), (B, 8))
+    state0 = jnp.broadcast_to(jnp.asarray(_H0, dtype=jnp.uint32), (B, 8))
     state0 = state0 ^ (wb[rows0, :8] & jnp.uint32(0))  # varying-axis align
 
     def step(state, t):

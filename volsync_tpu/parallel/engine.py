@@ -155,7 +155,8 @@ def make_chunk_hash_step(mesh, *, block_len: int = 64 * 1024,
         # starts from h=0), so zero the table values — zeroing the halo
         # bytes would still contribute _mix_u32(seed) per position.
         g = jnp.where(
-            (seq_i == 0) & (jnp.arange(ext.shape[1]) < _HALO)[None, :],
+            (seq_i == 0)
+            & (jnp.arange(ext.shape[1], dtype=jnp.int32) < _HALO)[None, :],
             jnp.uint32(0), g,
         )
         h = _gear_doubling(g)[:, _HALO:]  # [Wl, Sl]

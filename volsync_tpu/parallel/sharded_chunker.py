@@ -435,7 +435,8 @@ def _build_cand_fn(mesh, params: GearParams, shard_len: int, cap: int):
             row[-_HALO:], SEQ, [(j, (j + 1) % n) for j in range(n)])
         ext = jnp.concatenate([halo, row])
         g = _mix_u32(ext.astype(jnp.uint32) + seed)
-        g = jnp.where((i == 0) & (jnp.arange(ext.shape[0]) < _HALO),
+        g = jnp.where((i == 0)
+                      & (jnp.arange(ext.shape[0], dtype=jnp.int32) < _HALO),
                       jnp.uint32(0), g)
         h = _gear_doubling(g)[_HALO:]  # [Ls]
         pos = i * shard_len + jnp.arange(shard_len, dtype=jnp.int32)
