@@ -2,12 +2,12 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint test static-check clean-lint
+.PHONY: lint test chaos static-check clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
-# all rule families, VL001-VL005 per-file + VL101-VL104 interprocedural
-# + VL201-VL205 shape/dtype abstract interpretation, no baseline. Warm
-# runs re-analyze zero files; see docs/development.md.
+# all rule families, VL001-VL005 + VL105 per-file + VL101-VL104
+# interprocedural + VL201-VL205 shape/dtype abstract interpretation, no
+# baseline. Warm runs re-analyze zero files; see docs/development.md.
 lint:
 	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
 	    --no-baseline --format sarif --out lint.sarif --cache .lint-cache
@@ -15,6 +15,14 @@ lint:
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 	    -p no:cacheprovider
+
+# Chaos soak: backup -> restore over seeded fault schedules through the
+# resilience layer, plus the fault-injected crash-at-op-N recovery
+# scenarios (docs/robustness.md).
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+	    tests/test_resilience.py tests/test_crash_recovery.py \
+	    -q -m 'not slow' -p no:cacheprovider
 
 static-check:
 	scripts/static_check.sh

@@ -174,7 +174,9 @@ def _probe_backend(timeouts=PROBE_TIMEOUTS) -> Optional[str]:
         except subprocess.TimeoutExpired:
             _log(f"bench: probe attempt {i + 1} timed out after {tmo}s")
         if i + 1 < len(timeouts):
-            time.sleep(PROBE_BACKOFF_S)
+            # Device-settle pacing between subprocess probes, not an
+            # error-retry of a store call — RetryPolicy doesn't apply.
+            time.sleep(PROBE_BACKOFF_S)  # lint: ignore[VL105]
     return None
 
 
@@ -714,7 +716,8 @@ class _HostSegmentHasher:
 
 
 def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
-                   segment_mib: int = 2) -> dict:
+                   segment_mib: int = 2,
+                   fault_seed: Optional[int] = None) -> dict:
     """Serial-vs-pipelined backup data plane (``bench.py pipeline``).
 
     Streams a ``total_mib`` volume through stream_chunks ->
@@ -732,7 +735,13 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
     interval is lowered for the duration of the bench — at the default
     5 ms a single-core box pays up to one full interval per cross-thread
     future/queue handoff, which swamps the IO latency the pipeline is
-    hiding."""
+    hiding.
+
+    ``fault_seed`` (``bench.py pipeline --faults SEED``) arms the
+    deterministic fault-injection wrapper under the shared resilience
+    layer — the reported number is then GOODPUT under the seeded fault
+    schedule (VOLSYNC_FAULT_SPEC or the default transient+latency
+    profile), not clean-path throughput."""
     from volsync_tpu.engine.chunker import stream_chunks
     from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
     from volsync_tpu.obs import reset_spans, span_totals
@@ -746,8 +755,28 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
                         max_size=1024 * 1024, seed=7, align=4096)
 
     def run(pipelined: bool, limit: int = 0):
-        store = LatencyStore(MemObjectStore(), put_latency=put_latency_s)
-        repo = Repository.init(store)
+        lat = LatencyStore(MemObjectStore(), put_latency=put_latency_s)
+        if fault_seed is None:
+            repo = Repository.init(lat)
+        else:
+            from volsync_tpu.objstore.faultstore import maybe_wrap
+            from volsync_tpu.resilience import (
+                CircuitBreaker,
+                ResilientStore,
+                RetryPolicy,
+            )
+
+            # init on the clean store (put_if_absent is single-attempt
+            # by design), then run the data plane through the same
+            # layering open_store builds: faults UNDER the retry layer.
+            Repository.init(lat)
+            store = ResilientStore(
+                maybe_wrap(lat, seed=fault_seed),
+                policy=RetryPolicy(site="bench.faults", max_attempts=10,
+                                   base_delay=0.001, max_delay=0.01),
+                breaker=CircuitBreaker("bench", threshold=10**9,
+                                       reset_seconds=0.1))
+            repo = Repository.open(store)
         repo.pipelined = pipelined
         repo.PACK_TARGET = 1024 * 1024
         end = limit or total
@@ -767,14 +796,16 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
                 readahead=(2 if pipelined else 0)):
             repo.add_blob("data", digest, chunk)
         repo.flush()
-        return time.perf_counter() - t0, span_totals(), store
+        injected = (len(repo.store.inner.injected)
+                    if fault_seed is not None else 0)
+        return time.perf_counter() - t0, span_totals(), lat, injected
 
     prev_switch = sys.getswitchinterval()
     sys.setswitchinterval(0.0005)
     try:
         run(True, limit=4 << 20)  # warmup: pools, imports, first-call paths
-        serial_s, serial_spans, _ = run(False)
-        pipe_s, pipe_spans, pipe_store = run(True)
+        serial_s, serial_spans, _, _ = run(False)
+        pipe_s, pipe_spans, pipe_store, pipe_injected = run(True)
     finally:
         sys.setswitchinterval(prev_switch)
 
@@ -786,7 +817,7 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
                                   ("upload", "repo.pack_upload"),
                                   ("upload_wait", "repo.upload_wait"))}
 
-    return {
+    result = {
         "metric": "pipeline_backup_speedup",
         "value": round(serial_s / pipe_s, 2),
         "unit": "x",
@@ -800,6 +831,10 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
         "stages": stages(pipe_spans),
         "stages_serial": stages(serial_spans),
     }
+    if fault_seed is not None:
+        result["fault_seed"] = fault_seed
+        result["faults_injected"] = pipe_injected
+    return result
 
 
 def _pipeline_child(timeout_s: int = 180):
@@ -905,9 +940,20 @@ def _run_measurement_child(extra_env: dict, timeout_s: int) -> Optional[dict]:
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "pipeline":
         # Standalone stage-breakdown mode; host-side only, so pin the
-        # backend to CPU before anything imports jax.
+        # backend to CPU before anything imports jax. ``--faults SEED``
+        # arms the deterministic fault-injection wrapper so the number
+        # is goodput under a seeded fault schedule.
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        _emit(pipeline_bench())
+        fault_seed = None
+        if "--faults" in sys.argv[2:]:
+            i = sys.argv.index("--faults")
+            try:
+                fault_seed = int(sys.argv[i + 1])
+            except (IndexError, ValueError):
+                print("usage: bench.py pipeline [--faults SEED]",
+                      file=sys.stderr)
+                return 2
+        _emit(pipeline_bench(fault_seed=fault_seed))
         return 0
     if env_bool("VOLSYNC_BENCH_INNER"):
         return _inner_main()

@@ -148,8 +148,9 @@ def main() -> int:
             if best is None or parsed["value"] > best["value"]:
                 best = dict(parsed, rung=spec)
         # One rung at a time with a settle gap: the tunnel is
-        # single-tenant and back-to-back sessions can collide.
-        time.sleep(10)
+        # single-tenant and back-to-back sessions can collide. Pacing,
+        # not an error retry — RetryPolicy doesn't apply.
+        time.sleep(10)  # lint: ignore[VL105]
     artifact = {
         "artifact": f"BENCH_SELF_{tag}",
         "self_attested": True,

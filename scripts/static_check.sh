@@ -3,7 +3,8 @@
 #
 #   1. `volsync lint` over the whole tree — package, scripts/ and
 #      bench.py — must be clean with no baseline, with every rule
-#      family enabled: the per-file VL001-VL005 checks, the
+#      family enabled: the per-file VL001-VL005 checks plus VL105
+#      (ad-hoc retry sleeps outside resilience.py), the
 #      interprocedural VL101-VL104 family, and the VL201-VL205
 #      shape/dtype abstract interpreter
 #      (tests/test_analysis.py enforces the same in tier-1). Emits a

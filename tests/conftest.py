@@ -38,6 +38,17 @@ def rng():
     return np.random.RandomState(1234)
 
 
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    """Process-wide circuit breakers (resilience.breaker_for) must not
+    leak state between tests — a breaker tripped open by one test would
+    fail-fast every later test against the same backend name."""
+    yield
+    from volsync_tpu.resilience import reset_breakers
+
+    reset_breakers()
+
+
 @pytest.fixture
 def tmp_volume(tmp_path):
     """A small 'PVC': a directory tree with a few files."""

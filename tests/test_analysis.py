@@ -147,6 +147,59 @@ def test_vl005_direct_lock(tmp_path):
     assert _lint_file(tmp_path, src, subdir="cluster") == []
 
 
+def test_vl105_adhoc_retry(tmp_path):
+    src = (
+        "import time\n"
+        "import time as t\n"
+        "from time import sleep as zzz\n"
+        "def handler():\n"
+        "    try:\n"
+        "        x = 1\n"
+        "    except OSError:\n"
+        "        time.sleep(1)\n"       # VL105: sleep in except
+        "def retry_loop():\n"
+        "    for i in range(3):\n"
+        "        try:\n"
+        "            x = 1\n"
+        "        except OSError:\n"
+        "            pass\n"
+        "        t.sleep(0.1)\n"        # VL105: sleep in retry loop
+        "def while_retry():\n"
+        "    while True:\n"
+        "        try:\n"
+        "            break\n"
+        "        except OSError:\n"
+        "            pass\n"
+        "        zzz(0.1)\n"            # VL105: aliased from-import
+        "def pacing():\n"
+        "    for i in range(3):\n"      # loop without a try: pacing,
+        "        time.sleep(0.1)\n"     # not a retry loop — allowed
+        "def nested_reset():\n"
+        "    try:\n"
+        "        x = 1\n"
+        "    except OSError:\n"
+        "        def cb():\n"           # new function scope resets
+        "            time.sleep(1)\n"   # the except context — allowed
+        "        cb()\n"
+    )
+    findings = _lint_file(tmp_path, src)
+    assert _codes(findings) == ["VL105"] * 3
+    assert {f.line for f in findings} == {8, 15, 22}
+    # resilience.py implements the policy — exempt
+    assert _lint_file(tmp_path, src, name="resilience.py") == []
+
+
+def test_vl105_suppression(tmp_path):
+    src = ("import time\n"
+           "while True:\n"
+           "    try:\n"
+           "        break\n"
+           "    except OSError:\n"
+           "        pass\n"
+           "    time.sleep(1)  # lint: ignore[VL105] — paced poll\n")
+    assert _lint_file(tmp_path, src) == []
+
+
 def test_syntax_error_is_reported(tmp_path):
     f = tmp_path / "bad.py"
     f.write_text("def broken(:\n")

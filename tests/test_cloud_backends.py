@@ -13,7 +13,8 @@ import pytest
 
 from volsync_tpu.objstore.azure import AzureBlobStore
 from volsync_tpu.objstore.fakeazure import FakeAzureServer
-from volsync_tpu.objstore.store import NoSuchKey, open_store
+from volsync_tpu.objstore.faultstore import FaultSchedule, FaultStore
+from volsync_tpu.objstore.store import NoSuchKey, open_store, unwrap
 
 
 @pytest.fixture
@@ -103,9 +104,9 @@ def test_azure_missing_credentials():
 def test_b2_routes_to_s3_compat():
     from volsync_tpu.objstore.s3 import S3ObjectStore
 
-    st = open_store("b2:mybucket:/pfx", env={
+    st = unwrap(open_store("b2:mybucket:/pfx", env={
         "B2_ACCOUNT_ID": "id", "B2_ACCOUNT_KEY": "key",
-        "B2_REGION": "us-west-004"})
+        "B2_REGION": "us-west-004"}))
     assert isinstance(st, S3ObjectStore)
     assert st.bucket == "mybucket" and st.prefix == "pfx"
     assert "backblazeb2.com" in st.host
@@ -117,9 +118,9 @@ def test_b2_routes_to_s3_compat():
             "B2_ACCOUNT_ID": "id", "B2_ACCOUNT_KEY": "key"})
     # explicit endpoint, no region: the signing region derives from the
     # documented hostname shape (B2 validates the credential scope)
-    st2 = open_store("b2:mybucket:/pfx", env={
+    st2 = unwrap(open_store("b2:mybucket:/pfx", env={
         "B2_ACCOUNT_ID": "id", "B2_ACCOUNT_KEY": "key",
-        "B2_S3_ENDPOINT": "https://s3.eu-central-003.backblazeb2.com"})
+        "B2_S3_ENDPOINT": "https://s3.eu-central-003.backblazeb2.com"}))
     assert st2.region == "eu-central-003"
     with pytest.raises(ValueError, match="B2_REGION"):
         open_store("b2:mybucket:/pfx", env={
@@ -130,8 +131,8 @@ def test_b2_routes_to_s3_compat():
 def test_gs_routes_to_interop():
     from volsync_tpu.objstore.s3 import S3ObjectStore
 
-    st = open_store("gs:bkt:/p/q", env={
-        "GS_ACCESS_KEY_ID": "a", "GS_SECRET_ACCESS_KEY": "s"})
+    st = unwrap(open_store("gs:bkt:/p/q", env={
+        "GS_ACCESS_KEY_ID": "a", "GS_SECRET_ACCESS_KEY": "s"}))
     assert isinstance(st, S3ObjectStore)
     assert st.bucket == "bkt" and st.prefix == "p/q"
     assert "storage.googleapis.com" in st.host
@@ -279,12 +280,19 @@ def test_swift_unsupported_credential_families():
             "OS_PASSWORD": "pw", "OS_PROJECT_NAME": "proj"})
 
 
-@pytest.mark.parametrize("backend", ["s3", "azure", "swift"])
-def test_list_empty_prefix_contract(backend):
+@pytest.mark.parametrize("faults", [False, True],
+                         ids=["plain", "faultstore"])
+@pytest.mark.parametrize("backend", ["s3", "azure", "swift", "fs"])
+def test_list_empty_prefix_contract(backend, faults, tmp_path):
     """Cross-backend contract: list("") on a prefixed store yields
     exactly the store's own keys, correctly stripped — never objects of
     a sibling prefix sharing the same string head (the swift/azure bug:
-    prefix joined without a trailing '/')."""
+    prefix joined without a trailing '/').
+
+    The ``faultstore`` variants run the identical contract through
+    ``FaultStore`` with a zero-fault schedule over every backend,
+    pinning down that the fault-injection wrapper is TRANSPARENT when
+    nothing is scheduled."""
     from contextlib import ExitStack
 
     with ExitStack() as stack:
@@ -304,7 +312,7 @@ def test_list_empty_prefix_contract(backend):
             def mk(p):
                 return AzureBlobStore(srv.endpoint, srv.account,
                                       srv.key_b64, "backups", p)
-        else:
+        elif backend == "swift":
             from volsync_tpu.objstore.fakeswift import FakeSwiftServer
 
             srv = stack.enter_context(FakeSwiftServer())
@@ -318,6 +326,19 @@ def test_list_empty_prefix_contract(backend):
 
             def mk(p):
                 return open_store(f"swift:backups:/{p}", env=env)
+        else:
+            from volsync_tpu.objstore.store import FsObjectStore
+
+            def mk(p):
+                return FsObjectStore(tmp_path / p)
+
+        base_mk = mk
+        if faults:
+            # zero faults scheduled: every op must behave exactly as on
+            # the bare backend
+            def mk(p):  # noqa: F811 — deliberate wrap of base_mk
+                return FaultStore(base_mk(p),
+                                  FaultSchedule(seed=1234, specs=[]))
 
         a, b = mk("ns/repo"), mk("ns/repo-sibling")
         a.put("config", b"a")
@@ -327,6 +348,18 @@ def test_list_empty_prefix_contract(backend):
         assert sorted(a.list("")) == ["config", "data/00/obj"]
         assert sorted(b.list("")) == ["config", "data/00/other"]
         assert list(a.list("data/")) == ["data/00/obj"]
+        if faults:
+            # transparency extends past list: reads, conditional
+            # writes, metadata, delete — and nothing was injected
+            assert a.get("config") == b"a"
+            assert a.get_range("data/00/obj", 0, 1) == b"a"
+            assert a.exists("config") and not a.exists("nope")
+            assert a.size("config") == 1
+            assert a.put_if_absent("config", b"z") is False
+            assert a.put_if_absent("fresh", b"z") is True
+            a.delete("fresh")
+            assert not a.exists("fresh")
+            assert a.injected == [] and b.injected == []
 
 
 def test_swift_temp_url_routes_same_client(swift):
@@ -343,6 +376,6 @@ def test_swift_temp_url_routes_same_client(swift):
         "OS_PROJECT_NAME": srv.project,
         "OS_REGION_NAME": srv.region,
     })
-    assert isinstance(st, SwiftObjectStore)
+    assert isinstance(unwrap(st), SwiftObjectStore)
     st.put("k", b"v")
     assert st.get("k") == b"v"

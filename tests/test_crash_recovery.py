@@ -16,6 +16,11 @@ import pytest
 
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.engine import TreeBackup, restore_snapshot
+from volsync_tpu.objstore.faultstore import (
+    FaultSchedule,
+    FaultSpec,
+    FaultStore,
+)
 from volsync_tpu.objstore.store import FsObjectStore
 from volsync_tpu.repo.repository import Repository
 
@@ -199,3 +204,46 @@ def test_prune_sweeps_crash_orphans(tmp_path, src_tree):
                       for p in repo3._index.live_packs() if p}
     leftover_orphans = (orphan_packs & after) - referenced
     assert not leftover_orphans, leftover_orphans
+
+
+@pytest.mark.parametrize("prefix,at", [
+    ("data/", 2),    # killed at the 2nd pack upload (1st landed)
+    ("index/", 1),   # killed at the index persist (all packs landed)
+    ("locks/", 1),   # killed stamping the repository lock (no writes)
+], ids=["pack-upload", "index-persist", "lock-stamp"])
+def test_injected_crash_at_op_n_recovers(tmp_path, src_tree, prefix, at):
+    """Seeded crash-at-op-N (objstore/faultstore.py) across the three
+    write stages of a backup. InjectedCrash is classified fatal and
+    STICKY — in-flight upload-pool threads cannot quietly finish work
+    the dead process started — and a fresh open over the healthy store
+    must see a consistent repository whose retried backup restores
+    bit-exactly. Runs with the lock-order detector armed (autouse)."""
+    root = tmp_path / "store"
+    fs = FsObjectStore(str(root))
+    Repository.init(fs, chunker=CHUNKER)
+
+    faults = FaultStore(fs, FaultSchedule(seed=1, specs=[
+        FaultSpec(kind="crash", at=at, op="put", key_prefix=prefix)]))
+    repo = Repository.open(faults)
+    repo.PACK_TARGET = 64 * 1024  # several packs from the tree
+    # the pipelined uploader may wrap the crash in UploadError
+    with pytest.raises(Exception, match="injected crash|store is dead"):
+        TreeBackup(repo, workers=2).run(src_tree)
+    assert faults.crashed
+
+    # the restarted mover pod: fresh open over the healthy store
+    fresh = Repository.open(fs)
+    assert fresh.list_snapshots() == []
+    assert fresh.check(read_data=True) == []
+    # no index entry may reference a missing pack
+    with fresh._lock:
+        packs = [p for p in fresh._index.live_packs() if p]
+    for p in packs:
+        assert fs.exists(f"data/{p[:2]}/{p}"), p
+
+    snap, _ = TreeBackup(fresh, workers=2).run(src_tree)
+    assert snap
+    dst = tmp_path / "dst"
+    restore_snapshot(Repository.open(fs), dst)
+    for f in sorted(p.name for p in src_tree.iterdir()):
+        assert (dst / f).read_bytes() == (src_tree / f).read_bytes(), f

@@ -1,0 +1,474 @@
+"""Resilience layer unit tests: error classification, RetryPolicy
+(attempt accounting, jitter bounds, deadline, metrics), CircuitBreaker
+state machine, ResilientStore wrapping semantics, and the deterministic
+fault-injection wrapper (objstore/faultstore.py)."""
+
+import random
+
+import pytest
+
+from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
+from volsync_tpu.objstore.faultstore import (
+    FaultInjected,
+    FaultSchedule,
+    FaultSpec,
+    FaultStore,
+    InjectedCrash,
+    InjectedThrottle,
+    default_specs,
+    maybe_wrap,
+    parse_spec,
+)
+from volsync_tpu.objstore.store import MemObjectStore, NoSuchKey, unwrap
+from volsync_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpen,
+    DeadlineExceeded,
+    ResilientStore,
+    RetryPolicy,
+    ThrottleError,
+    TransientError,
+    breaker_for,
+    classify,
+    decorrelated_jitter,
+)
+
+
+def _policy(**kw):
+    kw.setdefault("sleep_fn", lambda s: None)
+    kw.setdefault("rng", random.Random(42))
+    return RetryPolicy(site="test", **kw)
+
+
+def _counter_value(site, outcome):
+    return GLOBAL_METRICS.retry_attempts.labels(
+        site=site, outcome=outcome)._value.get()
+
+
+# -- classification ---------------------------------------------------------
+
+class _HttpStatus(Exception):
+    def __init__(self, status):
+        self.status = status
+
+
+class _GrpcLike(Exception):
+    class _Code:
+        def __init__(self, name):
+            self.name = name
+
+    def __init__(self, name):
+        self._name = name
+
+    def code(self):
+        return self._Code(self._name)
+
+
+@pytest.mark.parametrize("exc,want", [
+    (TransientError("x"), True),
+    (ThrottleError("x"), True),
+    (NoSuchKey("k"), False),          # KeyError: a fact, not a fault
+    (ValueError("x"), False),
+    (TypeError("x"), False),
+    (_HttpStatus(503), True),
+    (_HttpStatus(429), True),
+    (_HttpStatus(404), False),
+    (_HttpStatus(501), False),        # permanent 5xx stays fatal
+    (_GrpcLike("UNAVAILABLE"), True),
+    (_GrpcLike("RESOURCE_EXHAUSTED"), True),
+    (_GrpcLike("UNAUTHENTICATED"), False),
+    (_GrpcLike("NOT_FOUND"), False),
+    (ConnectionResetError("x"), True),
+    (TimeoutError("x"), True),
+    (FileNotFoundError("x"), False),
+    (PermissionError("x"), False),
+    (OSError("reset"), True),         # generic transport OSError
+    (RuntimeError("x"), False),
+    (Exception("x"), False),
+])
+def test_classify(exc, want):
+    assert classify(exc) is want
+
+
+# -- RetryPolicy ------------------------------------------------------------
+
+def test_retry_then_success():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("boom")
+        return "ok"
+
+    p = _policy(max_attempts=5)
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert p.last_attempts == 3
+
+
+def test_fatal_raises_immediately():
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("bad request")
+
+    with pytest.raises(ValueError):
+        _policy(max_attempts=5).call(fatal)
+    assert len(calls) == 1
+
+
+def test_attempts_exhausted_raises_last():
+    p = _policy(max_attempts=3)
+
+    def always():
+        raise TransientError("still down")
+
+    with pytest.raises(TransientError):
+        p.call(always)
+    assert p.last_attempts == 3
+
+
+def test_retryable_fatal_tuples_override_classifier():
+    # RuntimeError is fatal by default; the retryable tuple opts it in
+    p = _policy(max_attempts=2, retryable=(RuntimeError,))
+    calls = []
+
+    def f():
+        calls.append(1)
+        raise RuntimeError("opted in")
+
+    with pytest.raises(RuntimeError):
+        p.call(f)
+    assert len(calls) == 2
+    # ...and the fatal tuple wins over both
+    p2 = _policy(max_attempts=5, retryable=(RuntimeError,),
+                 fatal=(RuntimeError,))
+    calls.clear()
+    with pytest.raises(RuntimeError):
+        p2.call(f)
+    assert len(calls) == 1
+
+
+def test_deadline_exceeded():
+    # deadline 0: the first backoff would overrun it
+    p = _policy(max_attempts=10, deadline=0.0)
+    with pytest.raises(DeadlineExceeded) as ei:
+        p.call(lambda: (_ for _ in ()).throw(TransientError("x")))
+    assert isinstance(ei.value.last, TransientError)
+
+
+def test_backoff_sleeps_recorded_and_bounded():
+    slept = []
+    p = RetryPolicy(site="test", max_attempts=4, base_delay=0.05,
+                    max_delay=0.2, sleep_fn=slept.append,
+                    rng=random.Random(7))
+    with pytest.raises(TransientError):
+        p.call(lambda: (_ for _ in ()).throw(TransientError("x")))
+    assert len(slept) == 3  # between 4 attempts
+    assert all(0.05 <= s <= 0.2 for s in slept)
+
+
+def test_decorrelated_jitter_bounds():
+    rng = random.Random(3)
+    prev = 0.05
+    for _ in range(200):
+        nxt = decorrelated_jitter(prev, 0.05, 1.0, rng)
+        assert 0.05 <= nxt <= 1.0
+        prev = nxt
+
+
+def test_backoffs_generator_capped():
+    p = _policy(base_delay=0.1, max_delay=0.5)
+    seq = [next(d) for d in [p.backoffs()] for _ in range(20)]
+    assert all(0.1 <= s <= 0.5 for s in seq)
+
+
+def test_retry_metrics_counted():
+    before_ok = _counter_value("metrics-site", "ok")
+    before_retried = _counter_value("metrics-site", "retried")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise TransientError("x")
+        return 1
+
+    p = RetryPolicy(site="metrics-site", max_attempts=3,
+                    sleep_fn=lambda s: None)
+    p.call(flaky)
+    assert _counter_value("metrics-site", "retried") == before_retried + 1
+    assert _counter_value("metrics-site", "ok") == before_ok + 1
+
+
+def test_from_env_overrides(monkeypatch):
+    monkeypatch.setenv("VOLSYNC_RETRY_ATTEMPTS", "7")
+    p = RetryPolicy.from_env("envsite")
+    assert p.max_attempts == 7
+    p2 = RetryPolicy.from_env("envsite", max_attempts=2)
+    assert p2.max_attempts == 2
+
+
+# -- CircuitBreaker ---------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trip_cooldown_halfopen_close():
+    clk = _Clock()
+    br = CircuitBreaker("be", threshold=2, reset_seconds=10.0, clock=clk)
+    assert br.state == "closed"
+    br.record_failure(TransientError("x"))
+    assert br.state == "closed"
+    br.record_failure(TransientError("x"))
+    assert br.state == "open"
+    with pytest.raises(CircuitOpen):
+        br.before_call()
+    # cooldown elapses -> half-open admits exactly one probe
+    clk.t += 11.0
+    br.before_call()  # the probe slot
+    with pytest.raises(CircuitOpen):
+        br.before_call()  # second caller shunted while probing
+    br.record_success()
+    assert br.state == "closed"
+    br.before_call()  # closed again: free passage
+
+
+def test_breaker_halfopen_failure_reopens():
+    clk = _Clock()
+    br = CircuitBreaker("be2", threshold=1, reset_seconds=5.0, clock=clk)
+    br.record_failure(TransientError("x"))
+    assert br.state == "open"
+    clk.t += 6.0
+    br.before_call()
+    br.record_failure(TransientError("x"))
+    assert br.state == "open"
+    with pytest.raises(CircuitOpen):
+        br.before_call()  # new cooldown running
+
+
+def test_breaker_ignores_fatal_errors():
+    br = CircuitBreaker("be3", threshold=1, reset_seconds=5.0)
+    br.record_failure(ValueError("caller bug"))
+    br.record_failure(NoSuchKey("k"))
+    assert br.state == "closed"
+
+
+def test_breaker_registry_shared_and_reset():
+    a = breaker_for("same-backend")
+    b = breaker_for("same-backend")
+    assert a is b
+    from volsync_tpu.resilience import reset_breakers
+
+    reset_breakers()
+    assert breaker_for("same-backend") is not a
+
+
+def test_policy_with_breaker_fails_fast_while_open():
+    clk = _Clock()
+    br = CircuitBreaker("be4", threshold=1, reset_seconds=60.0, clock=clk)
+    p = _policy(max_attempts=2, breaker=br)
+    with pytest.raises(TransientError):
+        p.call(lambda: (_ for _ in ()).throw(TransientError("x")))
+    assert br.state == "open"
+    # while open the callable is never invoked
+    calls = []
+    with pytest.raises(CircuitOpen):
+        _policy(max_attempts=1, breaker=br).call(
+            lambda: calls.append(1))
+    assert calls == []
+
+
+# -- ResilientStore ---------------------------------------------------------
+
+class _FlakyStore:
+    """MemObjectStore that fails the first N calls of selected ops."""
+
+    def __init__(self, fail_first=0, ops=("put", "get")):
+        self.inner = MemObjectStore()
+        self.failures_left = {op: fail_first for op in ops}
+        self.calls = []
+
+    def __getattr__(self, name):
+        target = getattr(self.inner, name)
+
+        def op(*a, **kw):
+            self.calls.append(name)
+            if self.failures_left.get(name, 0) > 0:
+                self.failures_left[name] -= 1
+                raise TransientError(f"flaky {name}")
+            return target(*a, **kw)
+
+        return op
+
+
+def _rstore(inner, **kw):
+    kw.setdefault("policy", _policy(max_attempts=5))
+    kw.setdefault("breaker", CircuitBreaker(
+        "test-store", threshold=10**9, reset_seconds=0.01))
+    return ResilientStore(inner, **kw)
+
+
+def test_resilient_store_retries_ops():
+    flaky = _FlakyStore(fail_first=2)
+    rs = _rstore(flaky)
+    rs.put("a/b", b"data")
+    assert rs.get("a/b") == b"data"
+    assert flaky.calls.count("put") == 3
+    assert flaky.calls.count("get") == 3
+
+
+def test_resilient_store_put_if_absent_single_attempt():
+    flaky = _FlakyStore(fail_first=1, ops=("put_if_absent",))
+    rs = _rstore(flaky)
+    with pytest.raises(TransientError):
+        rs.put_if_absent("k", b"v")
+    assert flaky.calls.count("put_if_absent") == 1
+
+
+def test_resilient_store_list_materialized_per_attempt():
+    flaky = _FlakyStore(fail_first=1, ops=("list",))
+    rs = _rstore(flaky)
+    rs.put("p/one", b"1")
+    rs.put("p/two", b"2")
+    assert sorted(rs.list("p/")) == ["p/one", "p/two"]
+    assert flaky.calls.count("list") == 2
+
+
+def test_unwrap_peels_wrappers():
+    mem = MemObjectStore()
+    assert unwrap(_rstore(FaultStore(mem, FaultSchedule(0, [])))) is mem
+
+
+# -- FaultStore -------------------------------------------------------------
+
+def test_parse_spec_roundtrip():
+    specs = parse_spec("transient:p=0.05,op=put;latency:p=0.1,ms=2;"
+                       "crash:at=40,op=put,prefix=data/,landed=1")
+    assert specs == [
+        FaultSpec(kind="transient", p=0.05, op="put"),
+        FaultSpec(kind="latency", p=0.1, latency=0.002),
+        FaultSpec(kind="crash", at=40, op="put", key_prefix="data/",
+                  landed=True),
+    ]
+    with pytest.raises(ValueError):
+        parse_spec("meteor:p=1")
+    with pytest.raises(ValueError):
+        parse_spec("transient:wat=1")
+
+
+def test_zero_schedule_is_transparent():
+    fs = FaultStore(MemObjectStore(), FaultSchedule(seed=1, specs=[]))
+    fs.put("a/k", b"v")
+    assert fs.get("a/k") == b"v"
+    assert fs.injected == []
+
+
+def test_fault_determinism_same_seed():
+    def run(seed):
+        fs = FaultStore(MemObjectStore(),
+                        FaultSchedule(seed=seed, specs=[
+                            FaultSpec(kind="transient", p=0.3)]))
+        for i in range(50):
+            try:
+                fs.put(f"k/{i}", b"x")
+            except FaultInjected:
+                pass
+        return [(op, key, kind) for (_, op, key, kind) in fs.injected]
+
+    a, b = run(7), run(7)
+    assert a == b and len(a) > 0
+    assert run(8) != a
+
+
+def test_fault_at_n_and_crash_sticky():
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="crash", at=3, op="put")]))
+    fs.put("k/1", b"a")
+    fs.put("k/2", b"b")
+    with pytest.raises(InjectedCrash):
+        fs.put("k/3", b"c")
+    assert fs.crashed
+    # dead store refuses everything, including reads
+    with pytest.raises(InjectedCrash):
+        fs.get("k/1")
+    # the crashed op did NOT land (landed=False default)
+    assert not fs.inner.exists("k/3")
+
+
+def test_fault_landed_write_then_error():
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="transient", at=1, op="put",
+                                  landed=True)]))
+    with pytest.raises(FaultInjected):
+        fs.put("k", b"committed")
+    # the PUT-committed/connection-died ambiguity: bytes are there
+    assert fs.inner.get("k") == b"committed"
+
+
+def test_fault_partial_put_torn_then_retry_overwrites():
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="partial_put", at=1, op="put")]))
+    data = b"0123456789abcdef"
+    with pytest.raises(FaultInjected):
+        fs.put("k", data)
+    assert fs.inner.get("k") == data[:8]  # torn half-object
+    fs.put("k", data)  # the retry must overwrite
+    assert fs.get("k") == data
+
+
+def test_fault_throttle_kind():
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="throttle", at=1)]))
+    with pytest.raises(InjectedThrottle):
+        fs.put("k", b"v")
+
+
+def test_fault_latency_sleeps(monkeypatch):
+    slept = []
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="latency", at=1, latency=0.005)]),
+                    sleep_fn=slept.append)
+    fs.put("k", b"v")
+    assert slept == [0.005]
+    assert fs.get("k") == b"v"
+
+
+def test_resilient_over_faultstore_masks_transients():
+    """The layering open_store builds: retries absorb injected faults
+    and the data comes back intact."""
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=11, specs=[
+                        FaultSpec(kind="transient", p=0.2)]))
+    rs = _rstore(fs, policy=_policy(max_attempts=10))
+    blobs = {f"d/{i}": bytes([i]) * 64 for i in range(30)}
+    for k, v in blobs.items():
+        rs.put(k, v)
+    for k, v in blobs.items():
+        assert rs.get(k) == v
+    assert len(fs.injected) > 0  # schedule actually fired
+
+
+def test_maybe_wrap_env_arming(monkeypatch):
+    mem = MemObjectStore()
+    assert maybe_wrap(mem) is mem  # unarmed: untouched
+    monkeypatch.setenv("VOLSYNC_FAULT_SEED", "123")
+    wrapped = maybe_wrap(mem)
+    assert isinstance(wrapped, FaultStore)
+    assert wrapped.schedule.seed == 123
+    assert wrapped.schedule.specs == default_specs()
+    monkeypatch.setenv("VOLSYNC_FAULT_SPEC", "throttle:p=0.5")
+    wrapped2 = maybe_wrap(mem)
+    assert wrapped2.schedule.specs == [FaultSpec(kind="throttle", p=0.5)]

@@ -7,11 +7,13 @@ every signature, plus a full restic-mover e2e whose repository lives in
 the fake bucket.
 """
 
+import http.client
+
 import pytest
 
 from volsync_tpu.objstore import NoSuchKey, open_store
 from volsync_tpu.objstore.fakes3 import FakeS3Server
-from volsync_tpu.objstore.s3 import S3Error, S3ObjectStore
+from volsync_tpu.objstore.s3 import S3Error, S3ObjectStore, SinkRetryRefused
 
 
 @pytest.fixture
@@ -133,6 +135,89 @@ def test_file_transfer_streams(server, tmp_path, rng):
     with pytest.raises(NoSuchKey):
         store.get_file("objects/missing", tmp_path / "nope")
     assert not (tmp_path / "nope").exists()
+
+
+class _DyingResponse:
+    """Streams a prefix of the body into the sink, then the connection
+    'drops' (IncompleteRead — an http.client.HTTPException, so the
+    transport policy classifies it retryable)."""
+
+    status = 200
+
+    def __init__(self, prefix: bytes):
+        self._chunks = [prefix]
+
+    def read(self, n=-1):
+        if self._chunks:
+            return self._chunks.pop()
+        raise http.client.IncompleteRead(b"")
+
+    def getheaders(self):
+        return []
+
+
+class _DyingConn:
+    def __init__(self, prefix: bytes):
+        self._prefix = prefix
+
+    def request(self, *args, **kwargs):
+        pass
+
+    def getresponse(self):
+        return _DyingResponse(self._prefix)
+
+
+def test_get_file_rewinds_sink_on_mid_body_retry(store, monkeypatch,
+                                                 tmp_path):
+    """A connection drop AFTER the sink has drained bytes must not
+    replay them: the retry rewinds a seekable sink to its pre-request
+    position, so the final file carries no duplicated prefix."""
+    payload = bytes(range(256)) * 512  # 128 KiB
+    store.put("obj", payload)
+    real_conn = store._conn
+    attempts = []
+
+    def flaky_conn():
+        attempts.append(1)
+        if len(attempts) == 1:
+            return _DyingConn(payload[:4096])
+        return real_conn()
+
+    monkeypatch.setattr(store, "_conn", flaky_conn)
+    dst = tmp_path / "out.bin"
+    n = store.get_file("obj", dst)
+    assert len(attempts) == 2  # first died mid-body, second completed
+    assert n == len(payload)
+    assert dst.read_bytes() == payload
+
+
+def test_unseekable_sink_refuses_mid_body_retry(store, monkeypatch):
+    """An unseekable sink that already consumed bytes cannot be rewound;
+    the retry must be refused (fatal), not silently duplicate data."""
+
+    class _Unseekable:
+        def __init__(self):
+            self.drained = bytearray()
+
+        def write(self, b):
+            self.drained += b
+
+        def tell(self):  # pipe-like: no position
+            raise OSError("unseekable")
+
+    store.put("obj", b"x" * 1024)
+    attempts = []
+
+    def flaky_conn():
+        attempts.append(1)
+        return _DyingConn(b"x" * 100)
+
+    monkeypatch.setattr(store, "_conn", flaky_conn)
+    sink = _Unseekable()
+    with pytest.raises(SinkRetryRefused):
+        store._request("GET", "obj", sink=sink)
+    assert len(attempts) == 1  # fatal on the first attempt — no blind retry
+    assert bytes(sink.drained) == b"x" * 100  # partial bytes, never replayed
 
 
 def test_repository_over_s3(server, tmp_path, rng):

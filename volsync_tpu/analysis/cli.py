@@ -26,9 +26,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="volsync lint",
         description="Repo-invariant AST lint for volsync-tpu "
-                    "(per-file rules VL001-VL005, interprocedural "
-                    "rules VL101-VL104, shape/dtype rules "
-                    "VL201-VL205; see docs/development.md)")
+                    "(per-file rules VL001-VL005 and VL105, "
+                    "interprocedural rules VL101-VL104, shape/dtype "
+                    "rules VL201-VL205; see docs/development.md)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to lint (default: the installed "

@@ -10,6 +10,9 @@ VL003  broad except that swallows silently (no log / re-raise)
 VL004  tracer-unsafe host ops inside jit'd functions (ops/ kernels)
 VL005  direct threading.Lock/RLock in data-plane modules (bypasses
        lockcheck instrumentation)
+VL105  ad-hoc retry: time.sleep inside an except handler or a retry
+       loop (a for/while containing a try) outside resilience.py —
+       route through resilience.RetryPolicy
 """
 
 from __future__ import annotations
@@ -330,6 +333,84 @@ class DirectLockRule:
                     f"VOLSYNC_TPU_LOCKCHECK can instrument it")
 
 
+class AdHocRetryRule:
+    """Every retry loop routes through resilience.RetryPolicy — one
+    audited story for classification, backoff jitter, deadlines, and
+    breaker/metrics integration. A ``time.sleep`` in an except handler
+    or in a loop that wraps a try is the signature of a hand-rolled
+    retry (the exact scatter PR 5 removed)."""
+
+    code = "VL105"
+    name = "adhoc-retry"
+    description = ("time.sleep inside an except handler or a retry loop "
+                   "(for/while containing a try) outside resilience.py")
+
+    @staticmethod
+    def _sleep_names(tree: ast.Module) -> tuple[set[str], set[str]]:
+        """(module aliases of ``time``, local names bound to
+        ``time.sleep``) — alias-aware, same pattern as VL001."""
+        time_aliases: set[str] = set()
+        sleep_names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        time_aliases.add(a.asname or "time")
+            elif (isinstance(node, ast.ImportFrom)
+                  and node.module == "time" and node.level == 0):
+                for a in node.names:
+                    if a.name == "sleep":
+                        sleep_names.add(a.asname or "sleep")
+        return time_aliases, sleep_names
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.in_module("resilience.py"):
+            return
+        time_aliases, sleep_names = self._sleep_names(ctx.tree)
+        if not time_aliases and not sleep_names:
+            return
+
+        def is_sleep(call: ast.Call) -> bool:
+            f = call.func
+            if (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in time_aliases):
+                return True
+            return isinstance(f, ast.Name) and f.id in sleep_names
+
+        findings: list[Finding] = []
+
+        def visit(node: ast.AST, in_except: bool, in_retry_loop: bool):
+            for child in ast.iter_child_nodes(node):
+                ie, irl = in_except, in_retry_loop
+                if isinstance(child, ast.ExceptHandler):
+                    ie = True
+                elif isinstance(child, (ast.For, ast.While)):
+                    if any(isinstance(n, ast.Try)
+                           for n in ast.walk(child)):
+                        irl = True
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.Lambda)):
+                    # a nested function is a fresh context: its sleeps
+                    # are judged by ITS loops/handlers, not the
+                    # enclosing ones
+                    ie = irl = False
+                if (isinstance(child, ast.Call) and (ie or irl)
+                        and is_sleep(child)):
+                    where = ("an except handler" if ie
+                             else "a retry loop")
+                    findings.append(finding_at(
+                        ctx.relpath, child, self.code,
+                        f"time.sleep in {where} — hand-rolled retry; "
+                        f"route through resilience.RetryPolicy "
+                        f"(policy.call or policy.backoffs())"))
+                visit(child, ie, irl)
+
+        visit(ctx.tree, False, False)
+        yield from findings
+
+
 def default_rules() -> list:
     return [EnvFlagRule(), ImportGateRule(), SilentExceptRule(),
-            TracerSafetyRule(), DirectLockRule()]
+            TracerSafetyRule(), DirectLockRule(), AdHocRetryRule()]

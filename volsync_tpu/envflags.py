@@ -179,6 +179,68 @@ def native_cache_dir() -> Optional[str]:
     return env_str("VOLSYNC_NATIVE_CACHE")
 
 
+# -- resilience layer knobs (resilience.py) ------------------------------
+
+def retry_attempts() -> int:
+    """Total tries per resilient call (1 = no retry)."""
+    return env_int("VOLSYNC_RETRY_ATTEMPTS", 4, minimum=1)
+
+
+def retry_base_delay() -> float:
+    """Backoff floor in seconds (VOLSYNC_RETRY_BASE_MS, milliseconds)."""
+    return env_float("VOLSYNC_RETRY_BASE_MS", 50.0, minimum=1.0) / 1000.0
+
+
+def retry_max_delay() -> float:
+    """Backoff cap in seconds (VOLSYNC_RETRY_MAX_MS, milliseconds)."""
+    return env_float("VOLSYNC_RETRY_MAX_MS", 5000.0, minimum=1.0) / 1000.0
+
+
+def retry_deadline() -> Optional[float]:
+    """Overall per-operation deadline in seconds
+    (VOLSYNC_RETRY_DEADLINE_S); unset/0 = no deadline."""
+    v = env_float("VOLSYNC_RETRY_DEADLINE_S", 0.0, minimum=0.0)
+    return v or None
+
+
+def breaker_threshold() -> int:
+    """Consecutive retryable failures before a backend's circuit
+    breaker opens."""
+    return env_int("VOLSYNC_BREAKER_THRESHOLD", 5, minimum=1)
+
+
+def breaker_reset_seconds() -> float:
+    """Cooldown before an open breaker admits the half-open probe."""
+    return env_float("VOLSYNC_BREAKER_RESET_S", 30.0, minimum=0.1)
+
+
+def store_resilience_enabled() -> bool:
+    """VOLSYNC_STORE_RESILIENCE=0 opts open_store() out of wrapping
+    network backends in the shared retry/breaker layer."""
+    return env_bool("VOLSYNC_STORE_RESILIENCE", True)
+
+
+# -- deterministic fault injection (objstore/faultstore.py) ---------------
+
+def fault_seed() -> Optional[int]:
+    """VOLSYNC_FAULT_SEED arms the deterministic fault-injection store
+    wrapper for stores opened via open_store(); None = disarmed."""
+    raw = env_str("VOLSYNC_FAULT_SEED")
+    if raw is None:
+        return None
+    try:
+        return int(raw.strip())
+    except ValueError:
+        return None
+
+
+def fault_spec() -> Optional[str]:
+    """VOLSYNC_FAULT_SPEC: fault-schedule spec string (see
+    objstore/faultstore.py parse_spec); None with a seed set means the
+    default transient-heavy profile."""
+    return env_str("VOLSYNC_FAULT_SPEC")
+
+
 # -- debug/verification toggles (analysis/lockcheck.py) ------------------
 
 def lockcheck_enabled() -> bool:

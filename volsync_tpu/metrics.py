@@ -60,6 +60,27 @@ class Metrics:
             "Current occupancy of each backup-pipeline stage queue",
             ["stage"], registry=self.registry,
         )
+        # Resilience layer (resilience.py): per-site attempt outcomes
+        # ("ok" — the attempt succeeded, "retried" — failed retryable,
+        # "fatal" — failed and classified non-retryable), and per-backend
+        # circuit-breaker state (0 closed / 1 open / 2 half-open) plus
+        # state-transition counts.
+        self.retry_attempts = Counter(
+            "volsync_retry_attempts_total",
+            "Resilient-call attempts by site and outcome",
+            ["site", "outcome"], registry=self.registry,
+        )
+        self.breaker_state = Gauge(
+            "volsync_breaker_state",
+            "Circuit-breaker state per backend "
+            "(0=closed, 1=open, 2=half-open)",
+            ["backend"], registry=self.registry,
+        )
+        self.breaker_transitions = Counter(
+            "volsync_breaker_transitions_total",
+            "Circuit-breaker state transitions per backend",
+            ["backend", "to"], registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":

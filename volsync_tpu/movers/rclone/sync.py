@@ -39,6 +39,7 @@ from volsync_tpu.objstore.store import (
     get_file,
     put_file,
 )
+from volsync_tpu.resilience import RetryPolicy
 
 log = logging.getLogger("volsync_tpu.movers.rclone")
 
@@ -129,13 +130,17 @@ class _MirrorLease:
                 f"{self.prefix}: mirror held by {others}")
         stop = threading.Event()
         self._stop = stop
+        restamp_policy = RetryPolicy.from_env(
+            "rclone.lease_restamp", max_attempts=2, base_delay=0.05,
+            max_delay=0.5, deadline=LOCK_REFRESH_SECONDS)
 
         def heartbeat():
             while not stop.wait(LOCK_REFRESH_SECONDS):
                 try:
-                    self._stamp()
-                except Exception as ex:  # noqa: BLE001 — keep
-                    # mirroring; the next beat retries the re-stamp
+                    restamp_policy.call(self._stamp)
+                except Exception as ex:  # noqa: BLE001 — log, don't
+                    # swallow silently; keep mirroring (staleness only
+                    # bites after LOCK_STALE_SECONDS of failed beats)
                     log.debug("mirror lease re-stamp failed "
                               "(retrying next beat): %s", ex)
         threading.Thread(target=heartbeat, daemon=True,

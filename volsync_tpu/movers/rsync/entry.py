@@ -23,6 +23,7 @@ from pathlib import Path
 
 from volsync_tpu.engine import deltasync
 from volsync_tpu.movers.rsync import channel
+from volsync_tpu.resilience import RetryPolicy
 
 log = logging.getLogger("volsync_tpu.mover.rsync")
 
@@ -252,6 +253,11 @@ def _publish_port(ctx, port: int):
 # ---------------------------------------------------------------------------
 
 
+class _PushCancelled(Exception):
+    """stop_event fired between attempts — classified fatal, so the
+    retry policy aborts instead of backing off."""
+
+
 def rsync_source_entrypoint(ctx) -> int:
     from volsync_tpu.movers import devicetransport as dt
 
@@ -262,36 +268,39 @@ def rsync_source_entrypoint(ctx) -> int:
     address = ctx.env["ADDRESS"]
     port = int(ctx.env["PORT"])
 
-    delay = 2.0
-    last_err = None
-    for attempt in range(MAX_RETRIES):  # source.sh:43-62
+    # source.sh:43-62 semantics via the shared layer: MAX_RETRIES
+    # attempts, 2s-based growing backoff; FAST_RETRY (tests) caps every
+    # sleep at 1s exactly as the old inline min(delay, 1.0) did.
+    policy = RetryPolicy.from_env(
+        "rsync.push", max_attempts=MAX_RETRIES, base_delay=2.0,
+        max_delay=(1.0 if ctx.env.get("FAST_RETRY") else 60.0),
+        retryable=(OSError, channel.ChannelError))
+
+    def push_once() -> int:
         if ctx.stop_event.is_set():
-            return 1
+            raise _PushCancelled()
+        # Mutual device auth: we pin the destination's ID, it pins
+        # ours — neither side ever held the other's private key.
+        ch = dt.connect_device(address, port, src_private, dest_id)
         try:
-            # Mutual device auth: we pin the destination's ID, it pins
-            # ours — neither side ever held the other's private key.
-            ch = dt.connect_device(address, port, src_private, dest_id)
-            try:
-                t0 = time.perf_counter()
-                stats = _push_tree(ch, root)
-                ch.send({"verb": "shutdown", "rc": 0})
-                ch.recv()
-                log.info("rsync push complete: %s", stats)
-                ctx.report_transfer(stats.get("bytes", 0),
-                                    time.perf_counter() - t0)
-                return 0
-            finally:
-                ch.close()
-        except (OSError, channel.ChannelError) as e:
-            last_err = e
-            log.warning("attempt %d failed: %s; retrying in %.0fs",
-                        attempt + 1, e, delay)
-            time.sleep(min(delay, 1.0) if ctx.env.get("FAST_RETRY")
-                       else delay)
-            delay *= 2
-    log.error("rsync push failed after %d attempts: %s", MAX_RETRIES,
-              last_err)
-    return 1
+            t0 = time.perf_counter()
+            stats = _push_tree(ch, root)
+            ch.send({"verb": "shutdown", "rc": 0})
+            ch.recv()
+            log.info("rsync push complete: %s", stats)
+            ctx.report_transfer(stats.get("bytes", 0),
+                                time.perf_counter() - t0)
+            return 0
+        finally:
+            ch.close()
+
+    try:
+        return policy.call(push_once)
+    except _PushCancelled:
+        return 1
+    except (OSError, channel.ChannelError) as e:
+        log.error("rsync push failed after %d attempts: %s", MAX_RETRIES, e)
+        return 1
 
 
 def _meta_of(st, p=None) -> dict:

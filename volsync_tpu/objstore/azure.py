@@ -23,6 +23,7 @@ from typing import Iterator, Optional
 from urllib.parse import quote, urlsplit
 
 from volsync_tpu.objstore.store import NoSuchKey, _check_key
+from volsync_tpu.resilience import RetryPolicy
 
 API_VERSION = "2021-08-06"
 _SAFE = "-_.~/"
@@ -80,6 +81,12 @@ class AzureBlobStore:
         self.container = container
         self.prefix = prefix.strip("/")
         self._local = threading.local()
+        # Transport-level policy: one reconnect on a stale keep-alive
+        # socket (the old inline loop's budget); op-level retry layers
+        # on in ResilientStore via open_store().
+        self._transport_policy = RetryPolicy.from_env(
+            "objstore.azure.transport", max_attempts=2, deadline=None,
+            base_delay=0.02, max_delay=0.25)
 
     @classmethod
     def from_url(cls, url: str, env: dict) -> "AzureBlobStore":
@@ -139,7 +146,7 @@ class AzureBlobStore:
         qs = "&".join(f"{quote(k, safe=_SAFE)}={quote(str(v), safe=_SAFE)}"
                       for k, v in sorted(query.items()))
         target = quote(path, safe=_SAFE) + (f"?{qs}" if qs else "")
-        for attempt in (0, 1):
+        def one_attempt() -> tuple[int, bytes, dict]:
             conn = self._conn()
             try:
                 conn.request(method, target, body=body or None,
@@ -148,11 +155,11 @@ class AzureBlobStore:
                 data = resp.read() if want_body else resp.read()
                 return resp.status, data, dict(resp.getheaders())
             except (http.client.HTTPException, ConnectionError, OSError):
-                # stale keep-alive: rebuild the connection once
+                # stale keep-alive: drop it so the retry dials fresh
                 self._local.conn = None
-                if attempt:
-                    raise
-        raise AssertionError("unreachable")
+                raise
+
+        return self._transport_policy.call(one_attempt)
 
     # -- ObjectStore protocol ----------------------------------------------
 

@@ -1,0 +1,302 @@
+"""Deterministic, seeded fault-injection ObjectStore wrapper.
+
+"Optimized Disaster Recovery for Distributed Storage Systems"
+(PAPERS.md) motivates verifying metadata/index consistency *under*
+failure, not only on the happy path. ``FaultStore`` wraps any
+ObjectStore and injects faults according to a seeded ``FaultSchedule``:
+
+- ``transient``   — a retryable error (connection-reset analogue); for
+                    writes, ``landed=1`` means the bytes reached the
+                    store BEFORE the error (the S3 PUT-committed /
+                    connection-died ambiguity).
+- ``throttle``    — a retryable 429/Slow-Down analogue.
+- ``latency``     — a latency spike (``ms=`` per hit).
+- ``partial_put`` — a TORN write: the store receives a truncated
+                    object, then the error raises. Retry must
+                    OVERWRITE, not skip-if-exists.
+- ``truncated_read`` — the connection drops mid-body (http.client
+                    raises IncompleteRead in real life); retryable.
+- ``crash``       — process death at operation N: a NON-retryable
+                    error, and the store goes dead (every later call
+                    fails too — in-flight worker threads cannot
+                    quietly finish work the "dead" process started).
+
+Determinism: probability rolls are a pure hash of
+``(seed, spec, op, key, nth-occurrence-of(op,key))`` — independent of
+thread interleaving, so the same seed over the same multiset of
+operations injects the same faults even under the concurrent upload
+pool. ``at=N`` (fire at the Nth matching op) counts arrivals under a
+lock and is deterministic for serial op sequences — what the
+crash-at-op-N recovery scenarios use. Every injection is recorded in
+``FaultStore.injected`` for replay assertions.
+
+Arming: construct directly (tests), or set ``VOLSYNC_FAULT_SEED`` (+
+optional ``VOLSYNC_FAULT_SPEC``) and open stores through
+``open_store()`` / ``maybe_wrap()`` — the CLI and bench.py
+(``--faults SEED``) ride that path.
+
+Spec strings (``parse_spec``): semicolon-separated entries
+``kind:key=value,...`` e.g. ::
+
+    transient:p=0.05,op=put;latency:p=0.1,ms=2;crash:at=40,op=put,prefix=data/
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from volsync_tpu import envflags
+from volsync_tpu.analysis import lockcheck
+from volsync_tpu.resilience import ThrottleError, TransientError
+
+
+class FaultInjected(TransientError):
+    """A scheduled transient fault (retryable by classification)."""
+
+
+class InjectedThrottle(ThrottleError):
+    """A scheduled throttle response (retryable)."""
+
+
+class InjectedCrash(RuntimeError):
+    """Scheduled process death — NOT retryable (plain RuntimeError, so
+    resilience.classify says fatal) and sticky: the store is dead."""
+
+
+_KINDS = ("transient", "throttle", "latency", "partial_put",
+          "truncated_read", "crash")
+#: ops that mutate the store — the ones ``landed`` applies to
+_WRITE_OPS = ("put", "put_if_absent", "delete")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One line of a fault schedule."""
+
+    kind: str                  # one of _KINDS
+    p: float = 0.0             # probability per matching op
+    at: Optional[int] = None   # fire at the Nth matching op (1-based)
+    op: str = "*"              # op name filter ("*" = any)
+    key_prefix: str = ""       # key startswith filter
+    landed: bool = False       # write ops: inner op completes first
+    latency: float = 0.0       # seconds, for kind="latency"
+
+    def matches(self, op: str, key: str) -> bool:
+        if self.op != "*" and op != self.op:
+            return False
+        return key.startswith(self.key_prefix)
+
+
+def parse_spec(text: str) -> list[FaultSpec]:
+    """Parse the VOLSYNC_FAULT_SPEC string format (module docstring)."""
+    specs: list[FaultSpec] = []
+    for entry in text.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, _, rest = entry.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(known: {', '.join(_KINDS)})")
+        kwargs: dict = {}
+        for pair in filter(None, (p.strip() for p in rest.split(","))):
+            k, _, v = pair.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k == "p":
+                kwargs["p"] = float(v)
+            elif k == "at":
+                kwargs["at"] = int(v)
+            elif k == "op":
+                kwargs["op"] = v
+            elif k == "prefix":
+                kwargs["key_prefix"] = v
+            elif k == "landed":
+                kwargs["landed"] = v not in ("", "0", "false", "no")
+            elif k == "ms":
+                kwargs["latency"] = float(v) / 1000.0
+            else:
+                raise ValueError(f"unknown fault spec field {k!r}")
+        specs.append(FaultSpec(kind=kind, **kwargs))
+    return specs
+
+
+def default_specs() -> list[FaultSpec]:
+    """The transient-heavy profile a bare VOLSYNC_FAULT_SEED arms."""
+    return [
+        FaultSpec(kind="transient", p=0.05),
+        FaultSpec(kind="latency", p=0.05, latency=0.002),
+    ]
+
+
+@dataclass
+class FaultSchedule:
+    """Seeded decision function over (op, key) arrivals."""
+
+    seed: int
+    specs: list = field(default_factory=default_specs)
+
+    def roll(self, spec_idx: int, op: str, key: str, n: int) -> float:
+        """Uniform [0,1) as a pure function of identity — thread-
+        interleaving-independent determinism."""
+        h = hashlib.blake2b(
+            f"{self.seed}:{spec_idx}:{op}:{key}:{n}".encode(),
+            digest_size=8).digest()
+        return int.from_bytes(h, "big") / float(1 << 64)
+
+
+class FaultStore:
+    """ObjectStore wrapper applying a FaultSchedule (module docstring).
+
+    With an all-zero schedule the wrapper is TRANSPARENT — the
+    cross-backend contract test runs every backend through it to pin
+    that down.
+    """
+
+    def __init__(self, inner, schedule: Optional[FaultSchedule] = None,
+                 *, seed: int = 0,
+                 sleep_fn=time.sleep):
+        self.inner = inner
+        self.schedule = (schedule if schedule is not None
+                         else FaultSchedule(seed=seed))
+        self.injected: list[tuple[int, str, str, str]] = []
+        self.crashed = False
+        self._sleep = sleep_fn
+        self._lock = lockcheck.make_lock("objstore.faults")
+        self._op_count = 0
+        # per-spec matching-op counters (for at=N) and per-(op,key)
+        # occurrence counters (for the pure-hash rolls)
+        self._spec_hits = [0] * len(self.schedule.specs)
+        self._occurrence: dict[tuple[str, str], int] = {}
+
+    # -- decision core ----------------------------------------------------
+
+    def _decide(self, op: str, key: str) -> list[FaultSpec]:
+        """All specs firing on this arrival, recorded. Raises
+        InjectedCrash immediately when the store is already dead."""
+        with self._lock:
+            if self.crashed:
+                raise InjectedCrash(
+                    f"store is dead (earlier injected crash); {op} "
+                    f"{key!r} refused")
+            self._op_count += 1
+            opix = self._op_count
+            n = self._occurrence.get((op, key), 0) + 1
+            self._occurrence[(op, key)] = n
+            fired: list[FaultSpec] = []
+            for i, spec in enumerate(self.schedule.specs):
+                if not spec.matches(op, key):
+                    continue
+                self._spec_hits[i] += 1
+                hit = (self._spec_hits[i] == spec.at if spec.at is not None
+                       else self.schedule.roll(i, op, key, n) < spec.p)
+                if hit:
+                    fired.append(spec)
+                    self.injected.append((opix, op, key, spec.kind))
+            if any(s.kind == "crash" for s in fired):
+                self.crashed = True
+        return fired
+
+    def _apply(self, op: str, key: str, execute, *,
+               torn_execute=None):
+        """Run one op under the schedule. ``execute()`` performs the
+        real operation; ``torn_execute()`` (writes only) performs the
+        truncated form for partial_put."""
+        fired = self._decide(op, key)
+        for spec in fired:
+            if spec.kind == "latency" and spec.latency > 0:
+                self._sleep(spec.latency)
+        crash = next((s for s in fired if s.kind == "crash"), None)
+        err = next((s for s in fired
+                    if s.kind in ("transient", "throttle", "partial_put",
+                                  "truncated_read")), None)
+        if crash is not None:
+            if crash.landed and op in _WRITE_OPS:
+                execute()
+            raise InjectedCrash(f"injected crash at {op} {key!r}")
+        if err is None:
+            return execute()
+        if err.kind == "partial_put" and torn_execute is not None:
+            torn_execute()
+            raise FaultInjected(f"injected torn write at {op} {key!r}")
+        if err.kind == "throttle":
+            raise InjectedThrottle(f"injected throttle at {op} {key!r}")
+        if err.kind == "truncated_read":
+            raise FaultInjected(f"injected truncated read at {op} {key!r}")
+        # transient
+        if err.landed and op in _WRITE_OPS:
+            execute()
+        raise FaultInjected(f"injected transient error at {op} {key!r}")
+
+    # -- ObjectStore protocol ---------------------------------------------
+
+    def put(self, key: str, data: bytes) -> None:
+        data = bytes(data)
+        self._apply("put", key, lambda: self.inner.put(key, data),
+                    torn_execute=lambda: self.inner.put(
+                        key, data[: max(0, len(data) // 2)]))
+
+    def put_if_absent(self, key: str, data: bytes) -> bool:
+        return self._apply("put_if_absent", key,
+                           lambda: self.inner.put_if_absent(key, data))
+
+    def get(self, key: str) -> bytes:
+        return self._apply("get", key, lambda: self.inner.get(key))
+
+    def get_range(self, key: str, offset: int, length: int) -> bytes:
+        return self._apply("get_range", key,
+                           lambda: self.inner.get_range(key, offset,
+                                                        length))
+
+    def exists(self, key: str) -> bool:
+        return self._apply("exists", key, lambda: self.inner.exists(key))
+
+    def delete(self, key: str) -> None:
+        self._apply("delete", key, lambda: self.inner.delete(key))
+
+    def size(self, key: str) -> int:
+        return self._apply("size", key, lambda: self.inner.size(key))
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        # materialized so the fault decision covers the whole listing,
+        # not just the first page pull
+        return iter(self._apply("list", prefix,
+                                lambda: list(self.inner.list(prefix))))
+
+    # file transfer rides the byte path so the schedule applies to it
+    # (bounded memory is irrelevant at chaos-test scale)
+    def put_file(self, key: str, src) -> None:
+        from pathlib import Path
+
+        self.put(key, Path(src).read_bytes())
+
+    def get_file(self, key: str, dst) -> int:
+        import os
+        from pathlib import Path
+
+        data = self.get(key)
+        dst = Path(dst)
+        tmp = dst.parent / f".volsync.tmp.{os.getpid()}.{dst.name}"
+        tmp.write_bytes(data)
+        tmp.replace(dst)
+        return len(data)
+
+
+def maybe_wrap(store, *, seed: Optional[int] = None,
+               spec: Optional[str] = None):
+    """Wrap ``store`` in a FaultStore when armed (explicitly or via
+    VOLSYNC_FAULT_SEED / VOLSYNC_FAULT_SPEC); otherwise return it
+    unchanged. The arming path tests, bench.py --faults, and the CLI
+    all share."""
+    if seed is None:
+        seed = envflags.fault_seed()
+    if seed is None:
+        return store
+    if spec is None:
+        spec = envflags.fault_spec()
+    specs = parse_spec(spec) if spec else default_specs()
+    return FaultStore(store, FaultSchedule(seed=seed, specs=specs))

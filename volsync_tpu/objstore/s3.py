@@ -28,6 +28,7 @@ from typing import Iterator, Optional
 from urllib.parse import quote, urlsplit
 
 from volsync_tpu.objstore.store import NoSuchKey, _check_key
+from volsync_tpu.resilience import RetryPolicy
 
 _ALGO = "AWS4-HMAC-SHA256"
 _SAFE = "-_.~"  # RFC 3986 unreserved (minus alnum, handled by quote)
@@ -95,6 +96,12 @@ class S3Error(RuntimeError):
         self.status = status
 
 
+class SinkRetryRefused(RuntimeError):
+    """A GET into an unseekable sink failed after bytes were already
+    written; retrying would duplicate them. Plain RuntimeError so
+    resilience.classify treats it as fatal."""
+
+
 class S3ObjectStore:
     """Bucket + key-prefix view over an S3-compatible endpoint."""
 
@@ -112,6 +119,13 @@ class S3ObjectStore:
         self.secret_key = secret_key
         self.region = region
         self._local = threading.local()
+        # Transport-level policy: the old behavior was exactly one
+        # reconnect on a stale pooled connection; op-level retry (with
+        # the full attempt budget and the backend breaker) layers on
+        # top in ResilientStore via open_store().
+        self._transport_policy = RetryPolicy.from_env(
+            "objstore.s3.transport", max_attempts=2, deadline=None,
+            base_delay=0.02, max_delay=0.25)
 
     # -- URL / env plumbing --------------------------------------------------
 
@@ -195,30 +209,51 @@ class S3ObjectStore:
         hdrs.update(headers or {})
         qs = canonical_query(query)
         path = quote(uri, safe="/" + _SAFE) + (f"?{qs}" if qs else "")
-        for attempt in (0, 1):
+        # Sink retry hazard: a connection drop AFTER sink.write() has
+        # consumed bytes must not replay those bytes. Seekable sinks are
+        # rewound (seek + truncate) to their pre-request position at the
+        # start of every attempt; an unseekable sink that has drained
+        # bytes refuses the retry with a fatal SinkRetryRefused.
+        sink_start: Optional[int] = None
+        if sink is not None:
+            try:
+                sink_start = sink.tell()
+            except (OSError, AttributeError):
+                sink_start = None
+
+        def one_attempt() -> tuple[int, dict, bytes]:
+            if sink is not None and sink_start is not None:
+                if sink.tell() != sink_start:
+                    sink.seek(sink_start)
+                    sink.truncate()
             conn = self._conn()
+            drained = 0
             try:
                 if hasattr(body, "seek"):
                     body.seek(0)
                 conn.request(method, path, body=body or None, headers=hdrs)
                 resp = conn.getresponse()
                 if sink is not None and resp.status in (200, 206):
-                    n = 0
                     while True:
                         chunk = resp.read(1 << 20)
                         if not chunk:
                             break
                         sink.write(chunk)
-                        n += len(chunk)
+                        drained += len(chunk)
                     return resp.status, dict(resp.getheaders()), b""
                 data = resp.read()
                 return resp.status, dict(resp.getheaders()), data
-            except (http.client.HTTPException, OSError):
-                # Stale pooled connection: drop it and retry once fresh.
+            except (http.client.HTTPException, OSError) as exc:
+                # Stale pooled connection: drop it so the next attempt
+                # dials fresh.
                 self._local.conn = None
-                if attempt:
-                    raise
-        raise AssertionError("unreachable")
+                if sink is not None and sink_start is None and drained:
+                    raise SinkRetryRefused(
+                        f"GET {key!r}: connection lost after {drained} "
+                        f"bytes reached an unseekable sink") from exc
+                raise
+
+        return self._transport_policy.call(one_attempt)
 
     # -- ObjectStore protocol ------------------------------------------------
 

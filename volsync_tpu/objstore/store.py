@@ -288,31 +288,65 @@ def open_store(url: str, env: Optional[dict] = None) -> ObjectStore:
       ``gs:bucket:/path`` (via GCS's S3-interop XML API, HMAC keys),
       ``swift:container:/path`` (Keystone v3 / v1 auth,
       objstore/swift.py), ``file:///path``, ``mem:``, or a bare path.
+
+    Network backends come back wrapped in the shared retry policy +
+    per-backend circuit breaker (resilience.ResilientStore; opt out
+    with VOLSYNC_STORE_RESILIENCE=0). Local/mem stores are not wrapped
+    — their failures are programming errors, not weather. Setting
+    VOLSYNC_FAULT_SEED arms the deterministic fault-injection wrapper
+    (objstore/faultstore.py) UNDER the resilience layer, exactly where
+    real faults occur.
     """
     import os as _os
+
+    from volsync_tpu import envflags as _envflags
+    from volsync_tpu.resilience import ResilientStore
+
+    def _resilient(store: ObjectStore, backend: str) -> ObjectStore:
+        from volsync_tpu.objstore.faultstore import maybe_wrap
+
+        store = maybe_wrap(store)
+        if not _envflags.store_resilience_enabled():
+            return store
+        return ResilientStore(store, backend=backend)
 
     env_map = dict(_os.environ if env is None else env)
     if url.startswith("s3:"):
         from volsync_tpu.objstore.s3 import S3ObjectStore
 
-        return S3ObjectStore.from_url(url, env=env)
+        return _resilient(S3ObjectStore.from_url(url, env=env), "s3")
     if url.startswith("azure:"):
         from volsync_tpu.objstore.azure import AzureBlobStore
 
-        return AzureBlobStore.from_url(url, env_map)
+        return _resilient(AzureBlobStore.from_url(url, env_map), "azure")
     if url.startswith("b2:"):
-        return _b2_store(url, env_map)
+        return _resilient(_b2_store(url, env_map), "b2")
     if url.startswith("gs:"):
-        return _gs_store(url, env_map)
+        return _resilient(_gs_store(url, env_map), "gs")
     if url.startswith("swift:") or url.startswith("swift-temp:"):
         from volsync_tpu.objstore.swift import SwiftObjectStore
 
-        return SwiftObjectStore.from_url(url, env_map)
+        return _resilient(SwiftObjectStore.from_url(url, env_map), "swift")
     if url.startswith("mem:"):
-        return MemObjectStore()
+        from volsync_tpu.objstore.faultstore import maybe_wrap
+
+        return maybe_wrap(MemObjectStore())
     if url.startswith("file://"):
-        return FsObjectStore(url[len("file://"):])
-    return FsObjectStore(url)
+        from volsync_tpu.objstore.faultstore import maybe_wrap
+
+        return maybe_wrap(FsObjectStore(url[len("file://"):]))
+    from volsync_tpu.objstore.faultstore import maybe_wrap
+
+    return maybe_wrap(FsObjectStore(url))
+
+
+def unwrap(store: ObjectStore) -> ObjectStore:
+    """Peel resilience/fault-injection wrappers off a store opened via
+    open_store() — diagnostics and tests that need the concrete backend
+    (wrappers all expose the wrapped store as ``.inner``)."""
+    while hasattr(store, "inner"):
+        store = store.inner
+    return store
 
 
 def _bucket_path(url: str, scheme: str) -> tuple[str, str]:

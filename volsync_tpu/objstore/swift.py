@@ -30,6 +30,7 @@ from urllib.parse import quote, urlsplit
 
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.objstore.store import NoSuchKey, _check_key
+from volsync_tpu.resilience import RetryPolicy
 
 _SAFE = "-_.~/"
 
@@ -126,6 +127,12 @@ class SwiftObjectStore:
         self.v1_key = v1_key
         self._pool = _HttpPool()
         self._auth_lock = lockcheck.make_lock("objstore.swift.auth")
+        # Transport-level policy: one reconnect on a stale keep-alive
+        # socket (the old did_reconn budget); op-level retry layers on
+        # in ResilientStore via open_store().
+        self._transport_policy = RetryPolicy.from_env(
+            "objstore.swift.transport", max_attempts=2, deadline=None,
+            base_delay=0.02, max_delay=0.25)
         # Pre-authenticated pair (OS_STORAGE_URL/OS_AUTH_TOKEN) skips
         # the auth round trip entirely; an empty token forces auth on
         # first use.
@@ -289,13 +296,12 @@ class SwiftObjectStore:
     def _request(self, method: str, key: str = "", *, query: str = "",
                  body: bytes = b"", headers: Optional[dict] = None,
                  container_only: bool = False) -> tuple[int, bytes, dict]:
-        # Independent one-shot budgets for the two transient failures a
+        # Two independent one-shot budgets for the transient failures a
         # long-idle store hits TOGETHER (stale keep-alive socket AND
         # expired token — e.g. an hourly backup with a 30-min token):
-        # one connection rebuild plus one re-auth must both be allowed
-        # in a single logical request.
-        did_reconn = did_reauth = False
-        while True:
+        # the transport policy allows one connection rebuild per probe,
+        # and the outer loop allows one re-auth per logical request.
+        def one_attempt() -> tuple[int, bytes, dict, str]:
             # reviewed: the auth HTTP round-trip runs under
             # objstore.swift.auth ON PURPOSE — it serializes re-auth so
             # N worker threads hitting an expired token produce one
@@ -319,13 +325,16 @@ class SwiftObjectStore:
                 resp = conn.getresponse()
                 data = resp.read()
             except (http.client.HTTPException, ConnectionError, OSError):
-                # stale keep-alive: rebuild the connection once
+                # stale keep-alive: drop it so the retry dials fresh
                 self._pool.reset()
-                if did_reconn:
-                    raise
-                did_reconn = True
-                continue
-            if resp.status == 401 and not did_reauth:
+                raise
+            return resp.status, data, dict(resp.getheaders()), token
+
+        did_reauth = False
+        while True:
+            status, data, hdrs, token = self._transport_policy.call(
+                one_attempt)
+            if status == 401 and not did_reauth:
                 # expired token: re-auth once and retry (restic's swift
                 # library does the same transparently)
                 did_reauth = True
@@ -333,7 +342,7 @@ class SwiftObjectStore:
                     if self._token == token:
                         self._token = ""
                 continue
-            return resp.status, data, dict(resp.getheaders())
+            return status, data, hdrs
 
     # -- ObjectStore protocol ----------------------------------------------
 

@@ -38,6 +38,7 @@ from volsync_tpu.obs import span
 from volsync_tpu.repo import blobid, crypto
 from volsync_tpu.repo.compactindex import CompactIndex
 from volsync_tpu.repo.compress import Compressor, Decompressor
+from volsync_tpu.resilience import RetryPolicy
 
 BLOB_DATA = "data"
 BLOB_TREE = "tree"
@@ -205,6 +206,12 @@ class Repository:
         self._pl_upload_slots = threading.BoundedSemaphore(
             envflags.upload_window())
         self._pl_retries = envflags.upload_retries()
+        # VOLSYNC_TPU_UPLOAD_RETRIES keeps its historical meaning
+        # (retries, not attempts); classification/backoff come from the
+        # shared layer.
+        self._upload_policy = RetryPolicy.from_env(
+            "repo.pack_upload", max_attempts=self._pl_retries + 1,
+            base_delay=0.05)
         self._pl_error: Optional[Exception] = None
         self._g_seal = GLOBAL_METRICS.pipeline_depth.labels(stage="seal")
         self._g_upload = GLOBAL_METRICS.pipeline_depth.labels(stage="upload")
@@ -344,6 +351,16 @@ class Repository:
         refresher = None
         try:
             deadline = time_mod.monotonic() + wait_seconds
+            # Randomized contender backoff: two acquirers started in
+            # lock-step (same cron tick on two hosts) must desynchronize
+            # or they re-collide every round until both time out. The
+            # shared decorrelated-jitter sequence keeps that property;
+            # bounds match the old uniform draw over
+            # [0.2, 1.0] * min(1.0, max(wait_seconds, 0.1)).
+            cap = min(1.0, max(wait_seconds, 0.1))
+            contend_delays = RetryPolicy.from_env(
+                "repo.lock_contend", base_delay=0.2 * cap,
+                max_delay=cap).backoffs()
             while True:
                 conflict = self._conflicting_lock(own, exclusive)
                 if conflict is None:
@@ -357,26 +374,30 @@ class Repository:
                     raise RepoLockedError(
                         f"repository is locked by {conflict} "
                         f"(wanted {'exclusive' if exclusive else 'shared'})")
-                # Randomized backoff: two contenders started in lock-step
-                # (same cron tick on two hosts) must desynchronize, or
-                # they re-collide every round until both time out.
-                import random
-
-                time_mod.sleep(
-                    min(1.0, max(wait_seconds, 0.1)) * random.uniform(0.2, 1.0))
+                time_mod.sleep(next(contend_delays))
                 own = self._write_lock(exclusive)
 
             lock_key = own
 
+            refresh_policy = RetryPolicy.from_env(
+                "repo.lock_refresh", max_attempts=2, base_delay=0.05,
+                max_delay=0.5, deadline=self.LOCK_REFRESH_SECONDS)
+
+            def restamp():
+                info = json.loads(self.store.get(lock_key))
+                info["time"] = datetime.now(timezone.utc).isoformat()
+                if stop.is_set():  # released while we were reading
+                    return
+                self.store.put(lock_key, json.dumps(info).encode())
+
             def refresh():
                 while not stop.wait(self.LOCK_REFRESH_SECONDS):
                     try:
-                        info = json.loads(self.store.get(lock_key))
-                        info["time"] = datetime.now(timezone.utc).isoformat()
-                        if stop.is_set():  # released while we were reading
-                            break
-                        self.store.put(lock_key, json.dumps(info).encode())
-                    except Exception as ex:  # noqa: BLE001 — keep holding
+                        refresh_policy.call(restamp)
+                    except Exception as ex:  # noqa: BLE001 — log, don't
+                        # swallow silently; keep holding (the next beat
+                        # re-stamps, staleness only bites after
+                        # LOCK_STALE_SECONDS of consecutive failures)
                         log.debug("repo lock refresh failed (retrying "
                                   "next beat): %s", ex)
                 # The refresher owns deletion: by the time we get here any
@@ -637,16 +658,8 @@ class Repository:
             blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
             pack_id = hashlib.sha256(blob).hexdigest()
             with span("repo.pack_upload"):
-                delay = 0.05
-                for attempt in range(self._pl_retries + 1):
-                    try:
-                        self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
-                        break
-                    except Exception:
-                        if attempt == self._pl_retries:
-                            raise
-                        time_mod.sleep(delay)
-                        delay *= 2
+                self._upload_policy.call(
+                    self.store.put, f"data/{pack_id[:2]}/{pack_id}", blob)
             return pack_id
         finally:
             self._pl_upload_slots.release()

@@ -12,6 +12,7 @@ from typing import Callable, Iterator, Optional
 
 import grpc
 
+from volsync_tpu.resilience import RetryPolicy
 from volsync_tpu.service import moverjax_pb2 as pb
 from volsync_tpu.service.server import SERVICE_NAME, TOKEN_METADATA_KEY
 
@@ -24,6 +25,13 @@ class MoverJaxClient:
         self._channel = grpc.insecure_channel(f"{address}:{port}")
         self._meta = ((TOKEN_METADATA_KEY, token),)
         self._timeout = timeout
+        # Unary calls retry under the shared policy (grpc.RpcError's
+        # .code() is classified: UNAVAILABLE-family retries,
+        # UNAUTHENTICATED/INVALID_ARGUMENT... is fatal). chunk_stream
+        # does NOT retry — a partially consumed reader() stream cannot
+        # be replayed; its caller owns re-driving the whole transfer.
+        self._policy = RetryPolicy.from_env("service.client",
+                                            call_timeout=timeout)
         ser = lambda m: m.SerializeToString()  # noqa: E731
         self._chunk_hash = self._channel.stream_stream(
             f"/{SERVICE_NAME}/ChunkHash",
@@ -83,13 +91,15 @@ class MoverJaxClient:
         req = pb.HashSpansRequest(data=data)
         for off, length in spans:
             req.spans.append(pb.Span(offset=off, length=length))
-        reply = self._hash_spans(req, metadata=self._meta,
-                                 timeout=self._timeout)
+        reply = self._policy.call(self._hash_spans, req,
+                                  metadata=self._meta,
+                                  timeout=self._timeout)
         return list(reply.digests)
 
     def info(self) -> pb.InfoResponse:
-        return self._info(pb.InfoRequest(), metadata=self._meta,
-                          timeout=self._timeout)
+        return self._policy.call(self._info, pb.InfoRequest(),
+                                 metadata=self._meta,
+                                 timeout=self._timeout)
 
 
 def open_client(address: str, port: int, token: str) -> MoverJaxClient:
