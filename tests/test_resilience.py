@@ -203,6 +203,20 @@ def test_retry_metrics_counted():
     assert _counter_value("metrics-site", "ok") == before_ok + 1
 
 
+def test_retry_metrics_exhausted_outcome():
+    """The final failed attempt of a retryable error counts as
+    'exhausted', not 'retried' — budget exhaustion must be
+    distinguishable from a retry that later succeeded."""
+    site = "metrics-exhaust"
+    before_retried = _counter_value(site, "retried")
+    before_exhausted = _counter_value(site, "exhausted")
+    p = RetryPolicy(site=site, max_attempts=3, sleep_fn=lambda s: None)
+    with pytest.raises(TransientError):
+        p.call(lambda: (_ for _ in ()).throw(TransientError("x")))
+    assert _counter_value(site, "retried") == before_retried + 2
+    assert _counter_value(site, "exhausted") == before_exhausted + 1
+
+
 def test_from_env_overrides(monkeypatch):
     monkeypatch.setenv("VOLSYNC_RETRY_ATTEMPTS", "7")
     p = RetryPolicy.from_env("envsite")
@@ -258,6 +272,24 @@ def test_breaker_ignores_fatal_errors():
     br = CircuitBreaker("be3", threshold=1, reset_seconds=5.0)
     br.record_failure(ValueError("caller bug"))
     br.record_failure(NoSuchKey("k"))
+    assert br.state == "closed"
+
+
+def test_breaker_halfopen_fatal_failure_releases_probe_slot():
+    """A probe that dies on a FATAL error (NoSuchKey) must still free
+    the probe slot and restart the cooldown — the regression wedged the
+    breaker half-open with the slot taken, failing every call forever."""
+    clk = _Clock()
+    br = CircuitBreaker("be5", threshold=1, reset_seconds=5.0, clock=clk)
+    br.record_failure(TransientError("x"))
+    assert br.state == "open"
+    clk.t += 6.0
+    br.before_call()  # probe admitted
+    br.record_failure(NoSuchKey("k"))  # fatal probe failure
+    assert br.state == "open"  # new cooldown, slot released
+    clk.t += 6.0
+    br.before_call()  # a NEW probe gets through — breaker not wedged
+    br.record_success()
     assert br.state == "closed"
 
 
@@ -472,3 +504,60 @@ def test_maybe_wrap_env_arming(monkeypatch):
     monkeypatch.setenv("VOLSYNC_FAULT_SPEC", "throttle:p=0.5")
     wrapped2 = maybe_wrap(mem)
     assert wrapped2.schedule.specs == [FaultSpec(kind="throttle", p=0.5)]
+
+
+def test_fault_seed_malformed_raises(monkeypatch):
+    """A typo'd seed must fail loudly, not silently disarm the chaos
+    harness and report a clean (fault-free) pass."""
+    from volsync_tpu import envflags
+
+    monkeypatch.setenv("VOLSYNC_FAULT_SEED", "forty-two")
+    with pytest.raises(ValueError, match="VOLSYNC_FAULT_SEED"):
+        envflags.fault_seed()
+    with pytest.raises(ValueError, match="VOLSYNC_FAULT_SEED"):
+        maybe_wrap(MemObjectStore())
+    monkeypatch.setenv("VOLSYNC_FAULT_SEED", " 42 ")
+    assert envflags.fault_seed() == 42
+
+
+class _FailingPackStore(MemObjectStore):
+    """Every pack put fails retryably; counts the attempts."""
+
+    def __init__(self):
+        super().__init__()
+        self.pack_puts = 0
+
+    def put(self, key, data):
+        if key.startswith("data/"):
+            self.pack_puts += 1
+            raise TransientError("down")
+        return super().put(key, data)
+
+
+def _upload_one_pack(repo):
+    repo._pl_upload_slots.acquire()
+    repo._upload_pack(b"x" * 16, [{"id": "a" * 64, "type": "data",
+                                   "offset": 0, "length": 16,
+                                   "raw_length": 16}])
+
+
+def test_repository_upload_no_retry_stacking():
+    """A ResilientStore-wrapped store is the ONE retry layer for pack
+    uploads — _upload_policy must not stack on top (the regression
+    multiplied attempt budgets into ~16+ network tries per bad pack)."""
+    from volsync_tpu.repo.repository import Repository
+
+    mem = _FailingPackStore()
+    rs = _rstore(mem, policy=_policy(max_attempts=2))
+    repo = Repository.init(rs)
+    with pytest.raises(TransientError):
+        _upload_one_pack(repo)
+    assert mem.pack_puts == 2  # store policy only, not *(_pl_retries+1)
+
+    # a bare store still gets the historical upload policy
+    mem2 = _FailingPackStore()
+    repo2 = Repository.init(mem2)
+    repo2._upload_policy.sleep_fn = lambda s: None
+    with pytest.raises(TransientError):
+        _upload_one_pack(repo2)
+    assert mem2.pack_puts == repo2._upload_policy.max_attempts

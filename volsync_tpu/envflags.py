@@ -231,7 +231,12 @@ def fault_seed() -> Optional[int]:
     try:
         return int(raw.strip())
     except ValueError:
-        return None
+        # Never disarm silently: a typo'd seed would let a "chaos" run
+        # report a clean pass while injecting nothing.
+        raise ValueError(
+            f"VOLSYNC_FAULT_SEED={raw!r} is not an integer; fix or "
+            "unset it (refusing to run with fault injection silently "
+            "disarmed)") from None
 
 
 def fault_spec() -> Optional[str]:

@@ -61,8 +61,9 @@ class Metrics:
             ["stage"], registry=self.registry,
         )
         # Resilience layer (resilience.py): per-site attempt outcomes
-        # ("ok" — the attempt succeeded, "retried" — failed retryable,
-        # "fatal" — failed and classified non-retryable), and per-backend
+        # ("ok" — the attempt succeeded, "retried" — failed retryable
+        # with attempts left, "exhausted" — failed retryable on the
+        # final attempt, "fatal" — classified non-retryable), and per-backend
         # circuit-breaker state (0 closed / 1 open / 2 half-open) plus
         # state-transition counts.
         self.retry_attempts = Counter(

@@ -38,7 +38,7 @@ from volsync_tpu.obs import span
 from volsync_tpu.repo import blobid, crypto
 from volsync_tpu.repo.compactindex import CompactIndex
 from volsync_tpu.repo.compress import Compressor, Decompressor
-from volsync_tpu.resilience import RetryPolicy
+from volsync_tpu.resilience import ResilientStore, RetryPolicy
 
 BLOB_DATA = "data"
 BLOB_TREE = "tree"
@@ -212,6 +212,14 @@ class Repository:
         self._upload_policy = RetryPolicy.from_env(
             "repo.pack_upload", max_attempts=self._pl_retries + 1,
             base_delay=0.05)
+        # One retry layer per pack upload: a store opened via
+        # open_store() already carries the shared retry/breaker layer
+        # (ResilientStore), and stacking _upload_policy on top would
+        # multiply attempt budgets (~16+ network tries with tiers of
+        # compounded backoff — one bad pack could stall an upload slot
+        # for minutes). The store's policy governs those uploads;
+        # _upload_policy applies only to bare stores.
+        self._store_retries = isinstance(store, ResilientStore)
         self._pl_error: Optional[Exception] = None
         self._g_seal = GLOBAL_METRICS.pipeline_depth.labels(stage="seal")
         self._g_upload = GLOBAL_METRICS.pipeline_depth.labels(stage="upload")
@@ -657,9 +665,12 @@ class Repository:
                 self._zc.compress(json.dumps(entries).encode()))
             blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
             pack_id = hashlib.sha256(blob).hexdigest()
+            key = f"data/{pack_id[:2]}/{pack_id}"
             with span("repo.pack_upload"):
-                self._upload_policy.call(
-                    self.store.put, f"data/{pack_id[:2]}/{pack_id}", blob)
+                if self._store_retries:
+                    self.store.put(key, blob)
+                else:
+                    self._upload_policy.call(self.store.put, key, blob)
             return pack_id
         finally:
             self._pl_upload_slots.release()

@@ -234,9 +234,13 @@ class RetryPolicy:
                         and not isinstance(exc, CircuitOpen)):
                     self.breaker.record_failure(exc)
                 retryable = self.is_retryable(exc)
+                exhausted = (retryable
+                             and attempt >= max(1, self.max_attempts))
                 _retry_counter(self.site,
-                               "retried" if retryable else "fatal").inc()
-                if not retryable or attempt >= max(1, self.max_attempts):
+                               "exhausted" if exhausted
+                               else "retried" if retryable
+                               else "fatal").inc()
+                if not retryable or exhausted:
                     raise
                 last = exc
                 delay = next(delays)
@@ -273,8 +277,11 @@ class CircuitBreaker:
     while open, ``before_call`` raises CircuitOpen without touching the
     backend. After ``reset_seconds`` ONE probe call is let through
     (half-open): success closes the circuit, failure re-opens it for
-    another cooldown. Fatal (non-retryable) errors do not trip the
-    breaker — a NoSuchKey storm is the caller's bug, not an outage.
+    another cooldown. Fatal (non-retryable) errors never count toward
+    the trip threshold — a NoSuchKey storm is the caller's bug, not an
+    outage — but a fatal probe failure still releases the probe slot
+    and restarts the cooldown (it proved nothing about health, and
+    keeping the slot would wedge the breaker half-open forever).
     """
 
     def __init__(self, backend: str, *, threshold: Optional[int] = None,
@@ -331,14 +338,19 @@ class CircuitBreaker:
             self._transition("closed")
 
     def record_failure(self, exc: BaseException):
-        if not classify(exc):
-            return  # fatal errors say nothing about backend health
+        retryable = classify(exc)
         with self._lock:
+            # The probe slot must be released on ANY failure, fatal or
+            # not — a probe that dies on NoSuchKey would otherwise wedge
+            # the breaker half-open with the slot taken forever, failing
+            # every future call with CircuitOpen.
             self._probing = False
             if self._state == "half-open":
                 self._opened_at = self._clock()
                 self._transition("open")
                 return
+            if not retryable:
+                return  # fatal errors say nothing about backend health
             self._failures += 1
             if self._failures >= self.threshold:
                 self._opened_at = self._clock()
