@@ -2,7 +2,7 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint test chaos static-check clean-lint
+.PHONY: lint test chaos static-check bench-index-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105 per-file + VL101-VL104
@@ -26,6 +26,14 @@ chaos:
 
 static-check:
 	scripts/static_check.sh
+
+# Small-scale metadata-plane bench (docs/performance.md): exercises the
+# batched/sharded/prefiltered index paths end to end and fails loudly
+# if any of them regress into errors. Scale-accurate numbers need the
+# full run: `python bench.py index` (1M entries).
+bench-index-smoke:
+	JAX_PLATFORMS=cpu python bench.py index --entries 50000 \
+	    --queries 20000
 
 clean-lint:
 	rm -f lint.sarif .lint-cache

@@ -99,6 +99,58 @@ def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
+def bench_provenance(extra: Optional[dict] = None) -> dict:
+    """Provenance block stamped into every bench JSON result: platform,
+    git rev, the VOLSYNC_*/JAX_PLATFORMS knobs in effect, and — only
+    when it can be read without side effects — the jax backend and
+    device kind. A CPU-fallback number must never be mistakable for a
+    chip number again (ROADMAP item 1).
+
+    Never *initializes* jax: ``jax.default_backend()`` on an
+    uninitialized import can hang on a wedged serving tunnel — the
+    exact failure this file exists to contain. The backend is reported
+    only if a backend already exists in this process or the env pins
+    CPU; otherwise it is labeled honestly as not initialized."""
+    import platform
+
+    prov: dict = {
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+    }
+    try:
+        r = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        prov["git_rev"] = (r.stdout.strip() if r.returncode == 0
+                           else "unknown")
+    except OSError as e:
+        _log(f"bench: git rev unavailable: {e}")
+        prov["git_rev"] = "unknown"
+    jx = sys.modules.get("jax")
+    if jx is None:
+        prov["jax_backend"] = "not-imported"
+    else:
+        bridge = getattr(getattr(jx, "_src", None), "xla_bridge", None)
+        initialized = bool(getattr(bridge, "_backends", None))
+        env = dict(os.environ)
+        if initialized or env.get("JAX_PLATFORMS", "").strip() == "cpu":
+            try:
+                prov["jax_backend"] = jx.default_backend()
+                prov["jax_device_kind"] = jx.devices()[0].device_kind
+            except Exception as e:  # noqa: BLE001 — label, never hang/abort
+                _log(f"bench: backend read failed: {e}")
+                prov["jax_backend"] = f"error:{type(e).__name__}"
+        else:
+            prov["jax_backend"] = "imported-uninitialized"
+    prov["volsync_flags"] = {
+        k: v for k, v in sorted(dict(os.environ).items())
+        if k.startswith("VOLSYNC_") or k == "JAX_PLATFORMS"}
+    if extra:
+        prov.update(extra)
+    return prov
+
+
 def _watchdog() -> None:
     time.sleep(GLOBAL_BUDGET_S)
     with _BEST_LOCK:
@@ -715,6 +767,159 @@ class _HostSegmentHasher:
         return out
 
 
+def _metric_value(name: str, labels: dict) -> float:
+    """Read one sample from the global registry via the public text
+    exposition (no private prometheus_client attribute access)."""
+    from volsync_tpu.metrics import GLOBAL as M
+
+    want = "{" + ",".join(f'{k}="{v}"' for k, v in sorted(labels.items())
+                          ) + "}" if labels else ""
+    for line in M.expose().decode().splitlines():
+        if not line.startswith(name):
+            continue
+        head, _, val = line.rpartition(" ")
+        if labels:
+            lb = head[head.find("{"):]
+            if sorted(lb.strip("{}").split(",")) != sorted(
+                    want.strip("{}").split(",")):
+                continue
+        elif "{" in head:
+            continue
+        return float(val)
+    return 0.0
+
+
+def index_bench(entries: int = 1_000_000, queries: int = 200_000,
+                batch: int = 4096, shards: Optional[int] = None) -> dict:
+    """Metadata-plane microbench (``bench.py index``): batched
+    vectorized dedup lookups vs the per-key scalar probe loop, and the
+    sharded index + blocked-bloom prefilter vs the single flat table.
+
+    Builds an index of ``entries`` random SHA-256-shaped keys, then
+    measures (a) scalar ``lookup``/``in`` per-key rates, (b) batched
+    ``lookup_many``/``contains_many`` rates in ``batch``-key slices for
+    pure-hit, pure-miss, and mixed workloads, and (c) the sharded
+    index's batched rates with prefilter skip/false-positive counts.
+    The headline value is the batched-vs-scalar hit-lookup speedup.
+    Host-side only — no jax, no device."""
+    from volsync_tpu.repo.compactindex import CompactIndex
+    from volsync_tpu.repo.shardedindex import ShardedBlobIndex
+
+    rng = np.random.RandomState(11)
+    raw = rng.bytes(32 * entries)
+    ids = [raw[i * 32:(i + 1) * 32].hex() for i in range(entries)]
+    raw_miss = rng.bytes(32 * queries)
+    miss = [raw_miss[i * 32:(i + 1) * 32].hex() for i in range(queries)]
+    hit_idx = rng.randint(0, entries, size=queries)
+    hits = [ids[i] for i in hit_idx.tolist()]
+    mixed = [h if i % 2 else m for i, (h, m) in
+             enumerate(zip(hits, miss))]
+
+    t0 = time.perf_counter()
+    single = CompactIndex(capacity=entries)
+    for i, h in enumerate(ids):
+        single.insert(h, f"pack{i >> 12}", "data", i, 1024, 2048)
+    build_single_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    sharded = ShardedBlobIndex(shards=shards, capacity=entries)
+    for i, h in enumerate(ids):
+        sharded.insert(h, f"pack{i >> 12}", "data", i, 1024, 2048)
+    build_sharded_s = time.perf_counter() - t0
+
+    nscalar = min(queries, 50_000)  # scalar loops are the slow side
+
+    def rate(n, secs):
+        return round(n / secs) if secs > 0 else 0
+
+    def timed(fn):
+        # One warmup pass first: the first touch of a ~66 MiB table
+        # after build is page faults and cache fills, not probe cost,
+        # and it would be billed to whichever workload ran first.
+        fn()
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    def scalar_hits():
+        for h in hits[:nscalar]:
+            single.lookup(h)
+
+    def scalar_misses():
+        for m in miss[:nscalar]:
+            m in single  # noqa: B015 — timing the membership probe
+
+    scalar_hit_s = timed(scalar_hits)
+    scalar_miss_s = timed(scalar_misses)
+
+    def batched(index, keys, fn):
+        def run():
+            for i in range(0, len(keys), batch):
+                fn(index, keys[i:i + batch])
+        return timed(run)
+
+    def lk(idx, ks):
+        idx.lookup_many(ks)
+
+    def ct(idx, ks):
+        idx.contains_many(ks)
+
+    batched_hit_s = batched(single, hits, lk)
+    batched_miss_s = batched(single, miss, ct)
+    batched_mixed_s = batched(single, mixed, ct)
+
+    skip0 = _metric_value("volsync_index_prefilter_total",
+                          {"outcome": "skip"})
+    fp0 = _metric_value("volsync_index_prefilter_total",
+                        {"outcome": "false_positive"})
+    sh_hit_s = batched(sharded, hits, lk)
+    sh_miss_s = batched(sharded, miss, ct)
+    sh_mixed_s = batched(sharded, mixed, ct)
+    # warmup+timed both ran: halve the counter deltas to report one pass
+    skips = (_metric_value("volsync_index_prefilter_total",
+                           {"outcome": "skip"}) - skip0) / 2
+    fps = (_metric_value("volsync_index_prefilter_total",
+                         {"outcome": "false_positive"}) - fp0) / 2
+
+    scalar_rate = nscalar / scalar_hit_s if scalar_hit_s > 0 else 0.0
+    batched_rate = queries / batched_hit_s if batched_hit_s > 0 else 0.0
+    speedup = round(batched_rate / scalar_rate, 2) if scalar_rate else 0.0
+    return {
+        "metric": "index_batched_lookup_speedup",
+        "value": speedup,
+        "unit": "x",
+        "entries": entries,
+        "queries": queries,
+        "batch": batch,
+        "shards": sharded._nshards,
+        "build": {
+            "single_s": round(build_single_s, 3),
+            "sharded_s": round(build_sharded_s, 3),
+            "inserts_per_s": rate(entries, build_single_s),
+        },
+        "scalar": {
+            "hit_lookup_per_s": rate(nscalar, scalar_hit_s),
+            "miss_contains_per_s": rate(nscalar, scalar_miss_s),
+        },
+        "batched": {
+            "hit_lookup_per_s": rate(queries, batched_hit_s),
+            "miss_contains_per_s": rate(queries, batched_miss_s),
+            "mixed_contains_per_s": rate(queries, batched_mixed_s),
+        },
+        "sharded_batched": {
+            "hit_lookup_per_s": rate(queries, sh_hit_s),
+            "miss_contains_per_s": rate(queries, sh_miss_s),
+            "mixed_contains_per_s": rate(queries, sh_mixed_s),
+            "prefilter_skips": int(skips),
+            "prefilter_false_positives": int(fps),
+            "prefilter_saturation": round(
+                sharded.prefilter_saturation(), 4),
+        },
+        "index_mib": round(single.nbytes() / (1 << 20), 1),
+        "provenance": bench_provenance(),
+    }
+
+
 def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
                    segment_mib: int = 2,
                    fault_seed: Optional[int] = None) -> dict:
@@ -741,8 +946,15 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
     deterministic fault-injection wrapper under the shared resilience
     layer — the reported number is then GOODPUT under the seeded fault
     schedule (VOLSYNC_FAULT_SPEC or the default transient+latency
-    profile), not clean-path throughput."""
-    from volsync_tpu.engine.chunker import stream_chunks
+    profile), not clean-path throughput.
+
+    The serial run adds chunks one ``add_blob`` (one lock + one scalar
+    probe) at a time; the pipelined run consumes per-segment batches
+    through ``add_blobs`` (one lock + one vectorized dedup query per
+    batch). ``dedup`` in the stage breakdown is the batched query time;
+    ``dedup_compare`` re-times the same key set scalar-vs-batched on
+    the finished repository."""
+    from volsync_tpu.engine.chunker import stream_chunk_batches
     from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
     from volsync_tpu.obs import reset_spans, span_totals
     from volsync_tpu.ops.gearcdc import GearParams
@@ -789,23 +1001,32 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
             return piece
 
         reset_spans()
+        ids: list = []
         t0 = time.perf_counter()
-        for chunk, digest in stream_chunks(
+        for chunks in stream_chunk_batches(
                 reader, params, segment_size=seg_size,
                 hasher=_HostSegmentHasher(),
                 readahead=(2 if pipelined else 0)):
-            repo.add_blob("data", digest, chunk)
+            if pipelined:
+                repo.add_blobs(
+                    "data", [(digest, chunk) for chunk, digest in chunks])
+            else:
+                for chunk, digest in chunks:
+                    repo.add_blob("data", digest, chunk)
+            ids.extend(digest for _, digest in chunks)
         repo.flush()
+        elapsed = time.perf_counter() - t0
         injected = (len(repo.store.inner.injected)
                     if fault_seed is not None else 0)
-        return time.perf_counter() - t0, span_totals(), lat, injected
+        return elapsed, span_totals(), lat, injected, repo, ids
 
     prev_switch = sys.getswitchinterval()
     sys.setswitchinterval(0.0005)
     try:
         run(True, limit=4 << 20)  # warmup: pools, imports, first-call paths
-        serial_s, serial_spans, _, _ = run(False)
-        pipe_s, pipe_spans, pipe_store, pipe_injected = run(True)
+        serial_s, serial_spans, _, _, _, _ = run(False)
+        (pipe_s, pipe_spans, pipe_store, pipe_injected, pipe_repo,
+         pipe_ids) = run(True)
     finally:
         sys.setswitchinterval(prev_switch)
 
@@ -813,9 +1034,38 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
         return {name: round(spans.get(key, (0, 0.0))[1], 4)
                 for name, key in (("read", "engine.read"),
                                   ("device", "engine.device"),
+                                  ("dedup", "repo.dedup_query"),
                                   ("seal", "repo.seal"),
                                   ("upload", "repo.pack_upload"),
                                   ("upload_wait", "repo.upload_wait"))}
+
+    def dedup_compare(repo, ids, rounds: int = 50):
+        """Per-chunk locking (one repo-lock + scalar probe per key, the
+        pre-batching dedup path) vs ONE has_blobs query per batch over
+        the run's whole 50/50 hit/miss key set — the shape of a warm
+        backup's unchanged-file check, which queries a file's entire
+        content list at once."""
+        rng = np.random.RandomState(5)
+        absent = [rng.bytes(32).hex() for _ in range(len(ids))]
+        keys = [k for pair in zip(ids, absent) for k in pair]
+        repo.has_blobs(keys)  # warm both paths' caches
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for k in keys:
+                repo.has_blob(k)
+        scalar_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            repo.has_blobs(keys)
+        batched_s = time.perf_counter() - t0
+        n = rounds * len(keys)
+        return {
+            "keys_per_batch": len(keys),
+            "scalar_us_per_key": round(scalar_s / n * 1e6, 3),
+            "batched_us_per_key": round(batched_s / n * 1e6, 3),
+            "speedup": (round(scalar_s / batched_s, 2)
+                        if batched_s > 0 else 0.0),
+        }
 
     result = {
         "metric": "pipeline_backup_speedup",
@@ -830,6 +1080,8 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
         "put_latency_ms": round(put_latency_s * 1000, 1),
         "stages": stages(pipe_spans),
         "stages_serial": stages(serial_spans),
+        "dedup_compare": dedup_compare(pipe_repo, pipe_ids),
+        "provenance": bench_provenance(),
     }
     if fault_seed is not None:
         result["fault_seed"] = fault_seed
@@ -888,6 +1140,7 @@ def _inner_main():
         "backend": backend,
         "path": "pallas" if _sha.use_pallas_leaves() else "xla",
         "config": config,
+        "provenance": bench_provenance(),
     }
     with _BEST_LOCK:
         _BEST = result
@@ -954,6 +1207,25 @@ def main():
                       file=sys.stderr)
                 return 2
         _emit(pipeline_bench(fault_seed=fault_seed))
+        return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "index":
+        # Metadata-plane microbench; host-side only (numpy, no device).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        kw: dict = {}
+        argv = sys.argv[2:]
+        spec = {"--entries": "entries", "--queries": "queries",
+                "--batch": "batch", "--shards": "shards"}
+        i = 0
+        while i < len(argv):
+            name = spec.get(argv[i])
+            try:
+                kw[name] = int(argv[i + 1])
+            except (TypeError, IndexError, ValueError):
+                print("usage: bench.py index [--entries N] [--queries N]"
+                      " [--batch N] [--shards N]", file=sys.stderr)
+                return 2
+            i += 2
+        _emit(index_bench(**kw))
         return 0
     if env_bool("VOLSYNC_BENCH_INNER"):
         return _inner_main()

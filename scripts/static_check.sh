@@ -14,6 +14,8 @@
 #   2. The pipeline + crash-recovery suites with the lock-order/race
 #      detector armed at process start (VOLSYNC_TPU_LOCKCHECK=1), so
 #      module-level locks are instrumented too.
+#   3. A small-scale metadata-plane bench smoke (`bench.py index`) so
+#      the batched/sharded/prefiltered index paths stay runnable.
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -27,5 +29,8 @@ echo "== lockcheck-armed pipeline suites =="
 JAX_PLATFORMS=cpu VOLSYNC_TPU_LOCKCHECK=1 \
     python -m pytest tests/test_lockcheck.py tests/test_pipeline.py \
         tests/test_crash_recovery.py -q -p no:cacheprovider
+
+echo "== bench-index-smoke =="
+make --no-print-directory bench-index-smoke > /dev/null
 
 echo "static_check: OK"

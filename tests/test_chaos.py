@@ -17,6 +17,8 @@ fatal, the backup dies like a killed mover pod, and a fresh open must
 see a consistent repository whose retry fully restores.
 """
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -189,6 +191,68 @@ def test_chaos_same_seed_same_fault_sequence(tmp_path):
     for op, key in faults.trace:
         _drive(replay, op, key)
     assert replay.injected == faults.injected
+
+
+def test_chaos_concurrent_backups_share_one_repository(tmp_path):
+    """Two movers, one repository: concurrent TreeBackup runs over the
+    same chaos stack and the same Repository object (shared repo lock,
+    sharded-index concurrent writers). Both snapshots must land, each
+    restores byte-identically to its own source tree, and no index
+    entry may reference a missing pack. Run under static_check.sh this
+    executes with the lock-order detector armed."""
+    rng = np.random.RandomState(9)
+    trees = []
+    for t in range(2):
+        src = tmp_path / f"src{t}"
+        src.mkdir()
+        for i in range(3):
+            (src / f"f{i}.bin").write_bytes(
+                rng.bytes(100_000 + 17 * i + t))
+        trees.append(src)
+    fs, faults, top = _chaos_stack(tmp_path / "store", 111,
+                                   [FaultSpec(kind="transient", p=0.10)])
+    Repository.init(fs, chunker=CHUNKER)
+    repo = Repository.open(top)
+    repo.PACK_TARGET = 64 * 1024
+    results: list = [None, None]
+    errors: list = []
+
+    def worker(t):
+        try:
+            snap, _ = TreeBackup(repo, workers=1).run(
+                trees[t], hostname=f"host{t}")
+            results[t] = snap
+        except Exception as e:  # surfaced via the errors assert below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,),
+                                name=f"chaos-backup-{t}")
+               for t in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    assert results[0] and results[1] and results[0] != results[1]
+    assert faults.injected, "schedule never fired — soak tested nothing"
+
+    # through the UNFAULTED store: clean check, both snapshots present,
+    # each restores byte-identically (selected by list position)
+    check = Repository.open(fs)
+    assert check.check(read_data=True) == []
+    ids = [s[0] for s in check.list_snapshots()]
+    assert set(results) <= set(ids)
+    for t in range(2):
+        dst = tmp_path / f"dst{t}"
+        prev = len(ids) - 1 - ids.index(results[t])
+        restore_snapshot(Repository.open(fs), dst, previous=prev)
+        for f in sorted(p.name for p in trees[t].iterdir()):
+            assert (dst / f).read_bytes() == (trees[t] / f).read_bytes(), f
+    with check._lock:
+        packs = [p for p in check._index.live_packs() if p]
+    for p in packs:
+        assert fs.exists(f"data/{p[:2]}/{p}"), \
+            f"dangling index entry -> {p}"
 
 
 def test_chaos_crash_midupload_then_recover(tmp_path):

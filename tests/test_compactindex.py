@@ -1,0 +1,251 @@
+"""Metadata-plane property tests: CompactIndex and ShardedBlobIndex
+fuzzed against a plain-dict model, batched-vs-scalar equivalence, the
+eager-snapshot iteration contract, and the bloom prefilter's
+no-false-negative guarantee.
+
+The fuzz drives every mutating op (insert, replace, setdefault-insert,
+remove, vacuum, copy) from tiny capacities so table rebuilds and
+tombstone reuse happen constantly, then checks the index agrees with
+the dict byte for byte. Snapshot keys are compared as raw 32-byte
+values (S32), never via ``.hex()`` of a ``tolist()`` round-trip —
+numpy strips trailing NULs from S32 scalars.
+"""
+
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from volsync_tpu.repo.compactindex import CompactIndex, as_key_rows
+from volsync_tpu.repo.shardedindex import (
+    BloomPrefilter,
+    ShardedBlobIndex,
+    _SMALL_BATCH_PER_SHARD,
+)
+
+
+def hex_ids(rng, n):
+    raw = rng.bytes(32 * n)
+    return [raw[i * 32:(i + 1) * 32].hex() for i in range(n)]
+
+
+def make_indexes():
+    return [
+        ("compact", CompactIndex(capacity=16)),
+        ("sharded1", ShardedBlobIndex(shards=1, capacity=16)),
+        ("sharded4", ShardedBlobIndex(shards=4, capacity=16)),
+        ("sharded16-nofilter",
+         ShardedBlobIndex(shards=16, capacity=16, prefilter=False)),
+    ]
+
+
+def check_equals_model(idx, model):
+    assert len(idx) == len(model)
+    assert dict(idx.items()) == model
+    for k, v in model.items():
+        assert k in idx
+        assert idx.lookup(k) == v
+    assert idx.live_packs() == {v[0] for v in model.values()}
+    keys, codes, names = idx.snapshot_arrays()
+    raw = keys.tobytes()  # S32 .tolist() would strip trailing NULs
+    snap = {raw[i * 32:(i + 1) * 32]: names[c]
+            for i, c in enumerate(codes.tolist())}
+    want = {bytes.fromhex(k): v[0] for k, v in model.items()}
+    assert snap == want
+
+
+@pytest.mark.parametrize("name,idx", make_indexes())
+def test_fuzz_against_dict_model(name, idx):
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**31)
+    universe = hex_ids(rng, 400)
+    model = {}
+    for step in range(3000):
+        op = rng.randint(100)
+        k = universe[rng.randint(len(universe))]
+        if op < 55:
+            entry = (f"p{rng.randint(6)}", "data", int(rng.randint(2**20)),
+                     int(rng.randint(1, 2**16)), int(rng.randint(1, 2**16)))
+            replace = bool(rng.randint(2))
+            changed = idx.insert(k, *entry, replace=replace)
+            if replace or k not in model:
+                assert changed
+                model[k] = entry
+            else:
+                assert not changed
+        elif op < 85:
+            assert idx.remove(k) == (k in model)
+            model.pop(k, None)
+        elif op < 93:
+            assert idx.lookup(k) == model.get(k)
+            assert (k in idx) == (k in model)
+        elif op < 97:
+            idx.vacuum()
+        else:
+            # copies are deep: mutating the original never leaks in
+            snap = idx.copy()
+            expect = dict(model)
+            idx.insert(universe[0], "pX", "data", 1, 2, 3)
+            idx.remove(universe[1])
+            assert dict(snap.items()) == expect
+            idx = snap
+            model = expect
+    check_equals_model(idx, model)
+    idx.vacuum()
+    check_equals_model(idx, model)
+
+
+@pytest.mark.parametrize("name,idx", make_indexes())
+def test_insert_after_vacuum_to_empty(name, idx):
+    # regression: vacuum with zero live entries used to truncate the
+    # entry block to length 0, and the next insert's doubling grow
+    # (0 * 2 == 0) then indexed past it
+    rng = np.random.RandomState(29)
+    ids = hex_ids(rng, 8)
+    for i, h in enumerate(ids):
+        idx.insert(h, "p0", "data", i, 1, 1)
+    for h in ids:
+        idx.remove(h)
+    idx.vacuum()
+    assert len(idx) == 0
+    for i, h in enumerate(ids):
+        assert idx.insert(h, "p1", "data", i, 2, 2)
+    check_equals_model(
+        idx, {h: ("p1", "data", i, 2, 2) for i, h in enumerate(ids)})
+
+
+def test_tombstone_reuse_and_rebuild_boundaries():
+    idx = CompactIndex(capacity=16)
+    rng = np.random.RandomState(3)
+    ids = hex_ids(rng, 64)
+    # churn one key through insert/remove cycles: tombstoned slots must
+    # be reused, not accumulate until lookups degrade or break
+    for i in range(200):
+        assert idx.insert(ids[0], "p0", "data", i, 1, 1)
+        assert idx.lookup(ids[0])[2] == i
+        assert idx.remove(ids[0])
+    assert len(idx) == 0 and ids[0] not in idx
+    # grow through several table rebuilds from the minimum capacity
+    for i, h in enumerate(ids):
+        idx.insert(h, "p0", "data", i, 1, 1)
+    assert len(idx) == 64
+    for i, h in enumerate(ids):
+        assert idx.lookup(h) == ("p0", "data", i, 1, 1)
+
+
+@pytest.mark.parametrize("name,idx", make_indexes())
+def test_items_survives_mutation_while_iterating(name, idx):
+    rng = np.random.RandomState(7)
+    ids = hex_ids(rng, 50)
+    for i, h in enumerate(ids):
+        idx.insert(h, "p0", "data", i, 1, 1)
+    expect = dict(idx.items())
+    it = idx.items()
+    seen = {}
+    for n, (k, v) in enumerate(it):
+        seen[k] = v
+        if n == 10:
+            # mutate hard mid-iteration: the eager snapshot must hold
+            for h in ids[:20]:
+                idx.remove(h)
+            idx.insert(hex_ids(rng, 1)[0], "p9", "data", 0, 1, 1)
+            idx.vacuum()
+    assert seen == expect
+
+
+@pytest.mark.parametrize("shards,prefilter", [(1, True), (4, True),
+                                              (16, True), (16, False)])
+def test_batched_matches_scalar(shards, prefilter):
+    idx = ShardedBlobIndex(shards=shards, capacity=16, prefilter=prefilter)
+    rng = np.random.RandomState(11)
+    present = hex_ids(rng, 600)
+    absent = hex_ids(rng, 600)
+    for i, h in enumerate(present):
+        idx.insert(h, f"p{i % 5}", "data", i, 1, 1)
+    for h in present[:100]:
+        idx.remove(h)
+    idx.vacuum()
+    keys = [k for pair in zip(present, absent) for k in pair]
+    # both code paths: a batch under the per-shard threshold (scalar
+    # probes) and the full batch (vectorized partition + probe)
+    small = keys[:max(1, _SMALL_BATCH_PER_SHARD * shards // 2)]
+    for batch in (small, keys):
+        got = idx.contains_many(batch)
+        assert got.dtype == np.bool_ and got.shape == (len(batch),)
+        assert got.tolist() == [k in idx for k in batch]
+        entries = idx.lookup_many(batch)
+        assert entries == [idx.lookup(k) for k in batch]
+
+
+def test_batched_accepts_all_key_forms():
+    idx = ShardedBlobIndex(shards=4, capacity=16)
+    rng = np.random.RandomState(13)
+    ids = hex_ids(rng, 40)
+    for i, h in enumerate(ids):
+        if i % 2 == 0:
+            idx.insert(h, "p0", "data", i, 1, 1)
+    expect = [h in idx for h in ids]
+    raw = b"".join(bytes.fromhex(h) for h in ids)
+    forms = [
+        ids,
+        np.frombuffer(raw, dtype=np.uint8).reshape(-1, 32),
+        np.frombuffer(raw, dtype="S32"),
+        as_key_rows(ids),
+    ]
+    for form in forms:
+        assert idx.contains_many(form).tolist() == expect
+    with pytest.raises(ValueError):
+        idx.contains_many(["ab"])  # not 32 bytes
+
+
+def test_prefilter_never_false_negative():
+    f = BloomPrefilter(capacity=256)
+    rng = np.random.RandomState(17)
+    rows = as_key_rows(hex_ids(rng, 512))  # 2x capacity: saturate hard
+    f.add_rows(rows[:256])
+    for r in rows[256:384]:
+        f.add_one(r)
+    added = rows[:384]
+    assert f.maybe_contains_rows(added).all()
+    assert 0.0 < f.saturation() < 1.0
+    # false positives exist but stay a small minority even oversubscribed
+    fresh = as_key_rows(hex_ids(rng, 2000))
+    fp = float(f.maybe_contains_rows(fresh).mean())
+    assert fp < 0.25
+
+
+def test_prefilter_rebuilds_on_vacuum_and_overflow():
+    idx = ShardedBlobIndex(shards=1, capacity=16, prefilter=True)
+    rng = np.random.RandomState(19)
+    ids = hex_ids(rng, 5000)
+    for i, h in enumerate(ids):
+        idx.insert(h, "p0", "data", i, 1, 1)
+    # growth forced filter rebuilds; everything must still be found
+    assert idx.contains_many(ids).all()
+    for h in ids[:4000]:
+        idx.remove(h)
+    idx.vacuum()
+    assert not idx.contains_many(ids[:4000]).any()
+    assert idx.contains_many(ids[4000:]).all()
+    assert 0.0 <= idx.prefilter_saturation() < 0.5
+
+
+def test_concurrent_inserts_are_all_visible():
+    idx = ShardedBlobIndex(shards=8, capacity=16)
+    rng = np.random.RandomState(23)
+    parts = [hex_ids(rng, 300) for _ in range(4)]
+
+    def writer(part, w):
+        for i, h in enumerate(part):
+            idx.insert(h, f"p{w}", "data", i, 1, 1)
+
+    threads = [threading.Thread(target=writer, args=(p, w),
+                                name=f"test-index-writer-{w}")
+               for w, p in enumerate(parts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    every = [h for p in parts for h in p]
+    assert len(idx) == len(every)
+    assert idx.contains_many(every).all()

@@ -20,7 +20,7 @@ from volsync_tpu import envflags
 from volsync_tpu.engine.chunker import (
     DeviceChunkHasher,
     params_from_config,
-    stream_chunks,
+    stream_chunk_batches,
 )
 from volsync_tpu.repo import blobid
 from volsync_tpu.repo.repository import (
@@ -292,10 +292,13 @@ class TreeBackup:
             inode_first[ino] = frel
         stats.bytes_scanned += st.st_size
         prev = parent_files.get(frel)
+        # One vectorized dedup query covers the whole previous content
+        # list (vs a lock/probe round-trip per blob) — unchanged-file
+        # checks on a warm repo are the dominant query source.
         if (prev is not None and prev["size"] == st.st_size
                 and prev["mtime_ns"] == st.st_mtime_ns
-                and all(self.repo.has_blob(b)
-                        for b in prev["content"])):
+                and (not prev["content"]
+                     or bool(self.repo.has_blobs(prev["content"]).all()))):
             stats.blobs_dedup += len(prev["content"])
             stats.bytes_dedup += st.st_size
             content = list(prev["content"])
@@ -373,11 +376,17 @@ class TreeBackup:
             hashed = 0
             reader_cm = self._open_stream(path)
             with reader_cm as reader:
-                for chunk, digest in stream_chunks(reader.read, self.params,
-                                                   hasher=self.hasher):
-                    self.repo.add_blob(BLOB_DATA, digest, chunk, stats)
-                    content.append(digest)
-                    hashed += len(chunk)
+                for batch in stream_chunk_batches(reader.read, self.params,
+                                                  hasher=self.hasher):
+                    # one batched dedup query + one lock acquisition
+                    # per device segment, not per chunk
+                    self.repo.add_blobs(
+                        BLOB_DATA,
+                        [(digest, chunk) for chunk, digest in batch],
+                        stats)
+                    for chunk, digest in batch:
+                        content.append(digest)
+                        hashed += len(chunk)
         try:
             mtime_ns = path.lstat().st_mtime_ns
         except OSError:  # deleted mid-backup: keep the walk-time stamp

@@ -643,12 +643,21 @@ class _ReadaheadStream:
         self._thread.join(timeout=5.0)
 
 
-def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
-                  segment_size: int = 32 * 1024 * 1024,
-                  hasher: Optional[DeviceChunkHasher] = None,
-                  readahead: Optional[int] = None,
-                  ) -> Iterator[tuple[bytes, str]]:
-    """Chunk an arbitrary-length stream -> (chunk bytes, sha256 hex).
+def stream_chunk_batches(reader: Callable[[int], bytes],
+                         params: GearParams,
+                         segment_size: int = 32 * 1024 * 1024,
+                         hasher: Optional[DeviceChunkHasher] = None,
+                         readahead: Optional[int] = None,
+                         ) -> Iterator[list[tuple[bytes, str]]]:
+    """Chunk an arbitrary-length stream -> per-segment batches of
+    (chunk bytes, sha256 hex).
+
+    Each yielded list is one device segment's full cut list — the
+    natural unit for the repository's batched dedup query
+    (``Repository.add_blobs``): the device already hashes a whole
+    segment per dispatch, so its chunks arrive together anyway.
+    Flattening the batches reproduces ``stream_chunks`` exactly (same
+    chunks, same digests, same order).
 
     ``reader(n)`` returns up to n bytes, b"" at EOF. Segments are chunked
     on device; the unterminated tail of each segment is carried into the
@@ -704,16 +713,18 @@ def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
                 seg_bytes, prev_token = prev
                 with span("engine.device"):
                     cuts = list(prev_token.finish())
-                for start, length, digest in cuts:
-                    yield seg_bytes[start: start + length], digest
+                if cuts:
+                    yield [(seg_bytes[start: start + length], digest)
+                           for start, length, digest in cuts]
             prev = (pending, token)
             pending = pending[consumed:]
             if eof:
                 seg_bytes, last = prev
                 with span("engine.device"):
                     cuts = list(last.finish())
-                for start, length, digest in cuts:
-                    yield seg_bytes[start: start + length], digest
+                if cuts:
+                    yield [(seg_bytes[start: start + length], digest)
+                           for start, length, digest in cuts]
                 return
             # A non-eof pass over more than max_size bytes always emits at
             # least one chunk (max_size forces a cut), so progress is
@@ -722,3 +733,18 @@ def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
     finally:
         if ra is not None:
             ra.close()
+
+
+def stream_chunks(reader: Callable[[int], bytes], params: GearParams,
+                  segment_size: int = 32 * 1024 * 1024,
+                  hasher: Optional[DeviceChunkHasher] = None,
+                  readahead: Optional[int] = None,
+                  ) -> Iterator[tuple[bytes, str]]:
+    """Flattened ``stream_chunk_batches``: chunk a stream ->
+    (chunk bytes, sha256 hex), one tuple per chunk. Byte-identical to
+    the batched form; callers that can act on a whole segment at once
+    (the backup engine's dedup query) should take the batches."""
+    for batch in stream_chunk_batches(reader, params,
+                                      segment_size=segment_size,
+                                      hasher=hasher, readahead=readahead):
+        yield from batch

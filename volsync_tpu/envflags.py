@@ -154,6 +154,24 @@ def restore_workers() -> int:
     return env_int("VOLSYNC_RESTORE_WORKERS", 4, minimum=1)
 
 
+# -- metadata plane (repo/shardedindex.py) -------------------------------
+
+def index_shards() -> int:
+    """Shard count for the repository blob index (rounded up to a power
+    of two by the index). Each shard has its own lock, so concurrent
+    writers contend on ~1/S of the keyspace; 1 degenerates to the
+    single-lock layout."""
+    return env_int("VOLSYNC_INDEX_SHARDS", 16, minimum=1)
+
+
+def index_prefilter() -> bool:
+    """VOLSYNC_INDEX_PREFILTER=0 disables the blocked-bloom cold-miss
+    prefilter in front of the index shards (first-backup workloads are
+    nearly all misses; the filter answers "definitely absent" without a
+    probe)."""
+    return env_bool("VOLSYNC_INDEX_PREFILTER", True)
+
+
 # -- observability (obs/tracing.py) --------------------------------------
 
 def trace_dir() -> Optional[str]:

@@ -82,6 +82,30 @@ class Metrics:
             "Circuit-breaker state transitions per backend",
             ["backend", "to"], registry=self.registry,
         )
+        # Metadata plane (repo/shardedindex.py): dedup keys resolved by
+        # the batched vectorized path, by result, plus the blocked-bloom
+        # prefilter's decisions — "skip" (definitely absent, probe
+        # avoided), "pass" (filter said maybe, probe found it),
+        # "false_positive" (filter said maybe, probe missed) — and the
+        # worst per-shard filter fill fraction (rebuilt on vacuum; near
+        # 1.0 means every query degrades to a real probe). The scalar
+        # per-key path is deliberately unmetered: a counter bump would
+        # roughly double its cost.
+        self.index_queries = Counter(
+            "volsync_index_queries_total",
+            "Batched dedup-index keys queried, by result",
+            ["result"], registry=self.registry,
+        )
+        self.index_prefilter = Counter(
+            "volsync_index_prefilter_total",
+            "Prefilter decisions for batched dedup-index queries",
+            ["outcome"], registry=self.registry,
+        )
+        self.index_prefilter_saturation = Gauge(
+            "volsync_index_prefilter_saturation",
+            "Max per-shard prefilter set-bit fraction (0..1)",
+            registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":
