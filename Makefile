@@ -2,7 +2,8 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint test chaos static-check bench-index-smoke clean-lint
+.PHONY: lint test chaos static-check bench-index-smoke \
+	service-bench-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105 per-file + VL101-VL104
@@ -34,6 +35,13 @@ static-check:
 bench-index-smoke:
 	JAX_PLATFORMS=cpu python bench.py index --entries 50000 \
 	    --queries 20000
+
+# Closed-loop multi-tenant service bench on CPU at smoke scale
+# (docs/service.md): drives the admission + WDRR scheduling stack end
+# to end and asserts the JSON contract (per-tenant latencies, shed
+# accounting, provenance block) so the bench stays runnable.
+service-bench-smoke:
+	VOLSYNC_SVCBENCH_SMOKE=1 python scripts/service_bench.py
 
 clean-lint:
 	rm -f lint.sarif .lint-cache

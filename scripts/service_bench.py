@@ -1,20 +1,41 @@
-"""mover-jax service concurrency benchmark (BASELINE configs[5] at the
-RPC layer): N concurrent ChunkHash client streams coalesce through the
-service's SegmentMicroBatcher into multi-lane device dispatches, and
-the aggregate GiB/s over the FULL service path (gRPC transport +
-streaming segmentation + batched device dispatch + result decode) is
-reported as ONE JSON line.
+"""mover-jax closed-loop multi-tenant service benchmark.
 
-This is the hardware form of tests/test_network_plane.py::
-test_service_microbatches_concurrent_streams — correctness is pinned
-there; this script measures. Run it ALONE on the single-tenant tunnel.
+PR-1's open-loop form measured raw coalesced throughput; this is the
+service-plane form: N closed-loop clients across >= 2 tenants each
+drive sequential ChunkHash streams against a server running the full
+admission + weighted-DRR scheduling stack (service/admission.py,
+service/scheduler.py), and the report is per tenant — p50/p99
+request latency, goodput, admitted/shed counts — plus plane-wide
+evidence that cross-tenant coalescing survived scheduling (device
+dispatches < segments submitted) and that overload was absorbed at
+admission (zero mid-stream aborts). One JSON line, stamped with
+bench.bench_provenance.
 
-Env knobs:
-  VOLSYNC_SVCBENCH_CLIENTS   concurrent streams        (default 8)
-  VOLSYNC_SVCBENCH_MIB       MiB per stream            (default 64)
-  VOLSYNC_SVCBENCH_SEG_KIB   service segment KiB       (default 4096)
-  VOLSYNC_SVCBENCH_WINDOW_MS batcher window            (default 2)
-  VOLSYNC_SVCBENCH_CPU       1 = force the CPU backend (labeled)
+Modes:
+  - normal          closed loop; a shed client honors the server's
+                    retry-after hint and retries (the shed still counts).
+  - force_breaker   trips the wired circuit breaker open first and
+                    measures the admission shed path's latency instead
+                    of throughput (acceptance (c): shed in < 10 ms).
+  - fault schedule  VOLSYNC_SVCBENCH_FAULT_SPEC arms a seeded
+                    FaultSchedule over the DEVICE DISPATCH path;
+                    latency-kind faults stall dispatches (stressing the
+                    credit pause and the DRR backlog). Error-kind
+                    faults are refused here — a CDC stream cannot be
+                    replayed mid-flight, so error injection lives in
+                    tests/test_service_chaos.py at the store layer.
+
+Env knobs (main()):
+  VOLSYNC_SVCBENCH_TENANTS    "name:weight:clients;..."  (gold:4:2;bronze:1:2)
+  VOLSYNC_SVCBENCH_REQUESTS   closed-loop requests per client (default 3)
+  VOLSYNC_SVCBENCH_MIB        MiB per request             (default 16)
+  VOLSYNC_SVCBENCH_SEG_KIB    service segment KiB         (default 4096)
+  VOLSYNC_SVCBENCH_WINDOW_MS  batcher window              (default 2)
+  VOLSYNC_SVCBENCH_MAX_STREAMS  global stream cap         (default 0 = env)
+  VOLSYNC_SVCBENCH_FORCE_BREAKER  1 = breaker-shed latency mode
+  VOLSYNC_SVCBENCH_FAULT_SPEC/ _FAULT_SEED  seeded dispatch-latency faults
+  VOLSYNC_SVCBENCH_SMOKE      1 = tiny CPU run + JSON-shape assertions
+  VOLSYNC_SVCBENCH_CPU        1 = force the CPU backend (labeled)
 """
 
 from __future__ import annotations
@@ -31,109 +52,380 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import numpy as np  # noqa: E402
 
 from volsync_tpu.envflags import (  # noqa: E402
-    env_bool, env_float, env_int)
+    env_bool, env_float, env_int, env_str)
+
+_PIECE = 1024 * 1024  # stream in 1 MiB pieces (gRPC 4 MiB msg cap)
+
+
+def _reader_for(buf: bytes):
+    pos = [0]
+
+    def read(nbytes: int) -> bytes:
+        p = buf[pos[0]: pos[0] + min(nbytes, _PIECE)]
+        pos[0] += len(p)
+        return p
+
+    return read
+
+
+def _percentile(xs: list, q: float) -> float:
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), q)) \
+        if xs else 0.0
+
+
+def parse_tenants(spec: str) -> list[dict]:
+    """``name:weight:clients;...`` -> [{name, weight, clients}, ...]."""
+    out = []
+    for entry in filter(None, (e.strip() for e in spec.split(";"))):
+        parts = entry.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"tenant spec entry {entry!r} is not name:weight:clients")
+        out.append({"name": parts[0], "weight": int(parts[1]),
+                    "clients": int(parts[2])})
+    if not out:
+        raise ValueError("empty tenant spec")
+    return out
+
+
+class _TenantTally:
+    """Per-tenant closed-loop accounting, shared by that tenant's
+    client threads."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: list[float] = []
+        self.shed_latencies: list[float] = []
+        self.bytes = 0
+        self.requests = 0
+        self.sheds = 0
+        self.mid_stream_aborts: list[str] = []
+
+
+def _arm_dispatch_faults(srv, fault_spec: str, fault_seed: int,
+                         dispatch_log: list):
+    """Wrap the server batcher's device dispatch with a spy (always)
+    and, when a spec is armed, seeded latency injection. Returns the
+    wrapped-over hasher so callers can restore it."""
+    from volsync_tpu.objstore.faultstore import FaultSchedule, parse_spec
+
+    specs = parse_spec(fault_spec) if fault_spec else []
+    bad = [s.kind for s in specs if s.kind != "latency"]
+    if bad:
+        raise ValueError(
+            f"dispatch-path fault injection supports latency only "
+            f"(got {bad}); error kinds belong to the store-layer chaos "
+            f"tests")
+    schedule = FaultSchedule(seed=fault_seed, specs=specs) if specs \
+        else None
+    hasher = srv._batcher._hasher
+    inner = hasher.hash_segments
+    calls = [0]
+    log_lock = threading.Lock()
+
+    def spy(items):
+        with log_lock:
+            calls[0] += 1
+            n = calls[0]
+            dispatch_log.append(len(items))
+        if schedule is not None:
+            for idx, spec in enumerate(specs):
+                if schedule.roll(idx, "dispatch", f"b{len(items)}",
+                                 n) < spec.p:
+                    time.sleep(spec.latency)
+        return inner(items)
+
+    hasher.hash_segments = spy
+    return hasher, inner
+
+
+def _run_clients(make_client, tenants: list[dict], payload_for,
+                 requests_per_client: int, tallies: dict) -> float:
+    """Closed loop: every client drives ``requests_per_client``
+    sequential streams, sleeping out the server's retry-after hint on a
+    shed. Returns the wall time of the whole phase."""
+    from volsync_tpu.service import ShedError
+
+    def loop(tenant: str, gidx: int):
+        tally: _TenantTally = tallies[tenant]
+        payload = payload_for(gidx)
+        with make_client(tenant) as c:
+            done = 0
+            while done < requests_per_client:
+                t0 = time.perf_counter()
+                got = 0
+                try:
+                    for _ in c.chunk_stream(_reader_for(payload)):
+                        got += 1
+                except ShedError as e:
+                    dt = time.perf_counter() - t0
+                    with tally.lock:
+                        tally.sheds += 1
+                        tally.shed_latencies.append(dt)
+                    # Closed-loop shed handling IS the thing under
+                    # measurement: honor the server's hint directly
+                    # (capped so a long breaker cooldown cannot stall
+                    # the bench) rather than routing through
+                    # RetryPolicy, whose jittered backoff would blur
+                    # the per-request latency being reported.
+                    time.sleep(min(e.retry_after, 0.2))  # lint: ignore[VL105]
+                    continue
+                except Exception as e:  # noqa: BLE001 — tallied, asserted on
+                    with tally.lock:
+                        tally.mid_stream_aborts.append(
+                            f"{tenant}[{gidx}] after {got} batches: {e!r}")
+                    done += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with tally.lock:
+                    tally.latencies.append(dt)
+                    tally.bytes += len(payload)
+                    tally.requests += 1
+                done += 1
+
+    threads = []
+    gidx = 0
+    for t in tenants:
+        for _ in range(t["clients"]):
+            threads.append(threading.Thread(
+                target=loop, args=(t["name"], gidx), daemon=True,
+                name=f"svcbench-{t['name']}-{gidx}"))
+            gidx += 1
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return time.perf_counter() - t0
+
+
+def run_closed_loop(*, tenants: list[dict], requests_per_client: int = 3,
+                    mib_per_request: int = 16, segment_kib: int = 4096,
+                    window_ms: float = 2.0, max_streams: int = 0,
+                    tenant_streams: int = 0, max_queued: int = 0,
+                    stream_credits: int = 0, force_breaker: bool = False,
+                    fault_spec: str = "", fault_seed: int = 0,
+                    params=None, warm: bool = True) -> dict:
+    """The importable benchmark core (the acceptance test drives it
+    directly). ``tenants`` is [{name, weight, clients[, streams]}, ...];
+    0 for any cap means "use the VOLSYNC_SVC_* default"."""
+    from bench import bench_provenance
+    from volsync_tpu.ops.gearcdc import GearParams
+    from volsync_tpu.repo import blobid
+    from volsync_tpu.resilience import CircuitBreaker, TransientError
+    from volsync_tpu.service import (
+        MoverJaxClient, MoverJaxServer, TenantConfig, TenantRegistry)
+
+    if params is None:
+        params = GearParams(min_size=64 * 1024, avg_size=1024 * 1024,
+                            max_size=4 * 1024 * 1024, align=4096)
+    registry = TenantRegistry(
+        TenantConfig(name=t["name"], weight=t["weight"],
+                     max_streams=t.get("streams"))
+        for t in tenants)
+    total_clients = sum(t["clients"] for t in tenants)
+    assert total_clients < 127, "salt space"
+
+    breaker = None
+    if force_breaker:
+        breaker = CircuitBreaker("svcbench", threshold=1,
+                                 reset_seconds=60.0)
+        breaker.record_failure(TransientError("svcbench: forced open"))
+        assert breaker.open_remaining() > 0
+
+    n = mib_per_request * 1024 * 1024
+    base = np.random.RandomState(7).randint(0, 256, size=(n,),
+                                            dtype=np.uint8)
+    # Per-client salted payloads, warm salts disjoint (128+i) from the
+    # timed ones (i+1): the serving tunnel memoizes identical
+    # executions, so a collision would replay for free and inflate the
+    # number (same invariant as bench.py's salted warm run).
+    payloads = [(base ^ np.uint8(i + 1)).tobytes()
+                for i in range(total_clients)]
+    warm_payloads = [(base ^ np.uint8(128 + i)).tobytes()
+                     for i in range(total_clients)]
+
+    dispatch_log: list[int] = []
+    srv = MoverJaxServer(
+        params=params, segment_size=segment_kib * 1024,
+        batch_window_ms=window_ms,
+        # enough executor workers that concurrency is bounded by
+        # ADMISSION, not by gRPC's thread pool queueing ahead of it
+        max_workers=total_clients + 4,
+        tenants=registry, breaker=breaker,
+        max_streams=max_streams or None,
+        tenant_streams=tenant_streams or None,
+        max_queued=max_queued or None,
+        stream_credits=stream_credits or None)
+    hasher, inner_hash = _arm_dispatch_faults(
+        srv, fault_spec, fault_seed, dispatch_log)
+
+    def make_client(tenant: str) -> MoverJaxClient:
+        return MoverJaxClient("127.0.0.1", srv.port, srv.token,
+                              tenant=tenant)
+
+    result: dict = {
+        "metric": "service_closed_loop",
+        "unit": "GiB/s",
+        "tenants": {},
+        "mib_per_request": mib_per_request,
+        "segment_kib": segment_kib,
+        "requests_per_client": requests_per_client,
+        "max_streams": max_streams or None,
+        "fault_spec": fault_spec or None,
+    }
+    try:
+        with srv:
+            if force_breaker:
+                result.update(_breaker_shed_phase(srv, make_client))
+                result["value"] = 0.0
+            else:
+                # Golden: one stream checked against hashlib before
+                # timing (warm salt — never colliding with timed data).
+                with make_client(tenants[0]["name"]) as cl:
+                    g = list(cl.chunk_stream(
+                        _reader_for(warm_payloads[0])))
+                s0, l0, d0 = g[0]
+                assert d0 == blobid.blob_id(
+                    warm_payloads[0][s0:s0 + l0]), \
+                    "service golden check failed"
+                tallies = {t["name"]: _TenantTally() for t in tenants}
+                if warm:
+                    # full concurrency so every pow2 lane-count kernel
+                    # the timed phase can hit is compiled up front
+                    _run_clients(make_client, tenants,
+                                 lambda i: warm_payloads[i], 1, tallies)
+                    aborts = [a for tl in tallies.values()
+                              for a in tl.mid_stream_aborts]
+                    assert not aborts, aborts
+                    tallies = {t["name"]: _TenantTally()
+                               for t in tenants}
+                dispatch_log.clear()
+                wall = _run_clients(make_client, tenants,
+                                    lambda i: payloads[i],
+                                    requests_per_client, tallies)
+                result.update(_report_load_phase(
+                    tenants, tallies, wall, dispatch_log))
+    finally:
+        hasher.hash_segments = inner_hash
+    import jax
+
+    result["backend"] = jax.default_backend()
+    result["provenance"] = bench_provenance()
+    return result
+
+
+def _breaker_shed_phase(srv, make_client) -> dict:
+    """Acceptance (c): with the breaker forced open, time the
+    admission shed path directly (the in-process bound the <10 ms
+    criterion pins) and once through a real client (the RPC-visible
+    bound, network stack included)."""
+    from volsync_tpu.service import ShedError
+    from volsync_tpu.service.admission import AdmissionRejected
+
+    direct: list[float] = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        try:
+            srv.admission.admit_stream("svcbench-probe")
+        except AdmissionRejected as rej:
+            assert rej.reason == "breaker_open", rej.reason
+        else:
+            raise AssertionError("breaker open but stream admitted")
+        direct.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    try:
+        with make_client("svcbench-probe") as c:
+            list(c.chunk_stream(_reader_for(b"x" * 4096)))
+    except ShedError as e:
+        rpc_dt, retry_after = time.perf_counter() - t0, e.retry_after
+    else:
+        raise AssertionError("breaker open but RPC stream admitted")
+    return {
+        "breaker": {
+            "direct_shed_p99_ms": round(_percentile(direct, 99) * 1e3, 4),
+            "direct_shed_max_ms": round(max(direct) * 1e3, 4),
+            "rpc_shed_ms": round(rpc_dt * 1e3, 3),
+            "retry_after_s": round(retry_after, 3),
+        },
+    }
+
+
+def _report_load_phase(tenants: list[dict], tallies: dict, wall: float,
+                       dispatch_log: list) -> dict:
+    per_tenant: dict = {}
+    total_bytes = 0
+    admitted = sheds = 0
+    aborts: list[str] = []
+    for t in tenants:
+        tl: _TenantTally = tallies[t["name"]]
+        total_bytes += tl.bytes
+        admitted += tl.requests
+        sheds += tl.sheds
+        aborts.extend(tl.mid_stream_aborts)
+        per_tenant[t["name"]] = {
+            "weight": t["weight"],
+            "clients": t["clients"],
+            "requests": tl.requests,
+            "shed": tl.sheds,
+            "p50_ms": round(_percentile(tl.latencies, 50) * 1e3, 2),
+            "p99_ms": round(_percentile(tl.latencies, 99) * 1e3, 2),
+            "goodput_gibs": round(tl.bytes / wall / (1 << 30), 3)
+            if wall > 0 else 0.0,
+        }
+    segments = sum(dispatch_log)
+    return {
+        "value": round(total_bytes / wall / (1 << 30), 3)
+        if wall > 0 else 0.0,
+        "wall_s": round(wall, 3),
+        "tenants": per_tenant,
+        "requests_total": admitted,
+        "shed_total": sheds,
+        "mid_stream_aborts": aborts,
+        "device_dispatches": len(dispatch_log),
+        "segments_dispatched": segments,
+        "max_batch_lanes": max(dispatch_log) if dispatch_log else 0,
+        # the coalescing acceptance signal: scheduling preserved
+        # cross-tenant batching (fewer dispatches than segments)
+        "coalesced": bool(dispatch_log) and len(dispatch_log) < segments,
+    }
 
 
 def main() -> int:
-    clients = env_int("VOLSYNC_SVCBENCH_CLIENTS", 8)
-    mib = env_int("VOLSYNC_SVCBENCH_MIB", 64)
-    seg_kib = env_int("VOLSYNC_SVCBENCH_SEG_KIB", 4096)
-    window_ms = env_float("VOLSYNC_SVCBENCH_WINDOW_MS", 2.0)
-    if env_bool("VOLSYNC_SVCBENCH_CPU"):
+    smoke = env_bool("VOLSYNC_SVCBENCH_SMOKE")
+    if env_bool("VOLSYNC_SVCBENCH_CPU") or smoke:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    # (no VOLSYNC_BATCH_SEGMENTS needed: the server builds its own
-    # microbatcher from batch_window_ms, bypassing the shared gate)
-
-    import jax
-
-    from volsync_tpu.ops.gearcdc import GearParams
-    from volsync_tpu.repo import blobid
-    from volsync_tpu.service import MoverJaxClient, MoverJaxServer
-
-    params = GearParams(min_size=64 * 1024, avg_size=1024 * 1024,
-                        max_size=4 * 1024 * 1024, align=4096)
-    n = mib * 1024 * 1024
-    base = np.random.RandomState(7).randint(0, 256, size=(n,),
-                                            dtype=np.uint8)
-    # Per-client salted payloads: the serving tunnel memoizes identical
-    # executions, so every stream must hash distinct content.
-    payloads = [(base ^ np.uint8(i + 1)).tobytes()
-                for i in range(clients)]
-
-    piece = 1024 * 1024  # stream in 1 MiB pieces (gRPC 4 MiB msg cap)
-
-    def reader_for(buf: bytes):
-        pos = [0]
-
-        def read(nbytes: int) -> bytes:
-            p = buf[pos[0]: pos[0] + min(nbytes, piece)]
-            pos[0] += len(p)
-            return p
-
-        return read
-
-    assert clients < 127, "salt space"
-    # Warm payloads carry DISJOINT salts (128+i) from the timed ones
-    # (i+1): the serving tunnel memoizes identical executions, so a
-    # warm/timed collision would replay for free and inflate the
-    # number (same invariant as bench.py's salted warm run).
-    warm_payloads = [(base ^ np.uint8(128 + i)).tobytes()
-                     for i in range(clients)]
-
-    counts = [0] * clients
-    errors: list = []
-
-    def run_one(srv, idx: int, bufs: list):
-        try:
-            with MoverJaxClient("127.0.0.1", srv.port, srv.token) as c:
-                out = list(c.chunk_stream(reader_for(bufs[idx])))
-            counts[idx] = len(out)
-        except Exception as e:  # noqa: BLE001
-            errors.append(f"client {idx}: {e}")
-
-    def run_all(srv, bufs: list):
-        threads = []
-        for i in range(clients):
-            t = threading.Thread(target=run_one, args=(srv, i, bufs),
-                                 name=f"svcbench-client-{i}")
-            threads.append(t)
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        return time.perf_counter() - t0
-
-    with MoverJaxServer(params=params, segment_size=seg_kib * 1024,
-                        batch_window_ms=window_ms) as srv:
-        # Golden: one stream checked against hashlib before timing.
-        with MoverJaxClient("127.0.0.1", srv.port, srv.token) as cl:
-            g = list(cl.chunk_stream(reader_for(warm_payloads[0])))
-        s0, l0, d0 = g[0]
-        assert d0 == blobid.blob_id(warm_payloads[0][s0:s0 + l0]), \
-            "service golden check failed"
-        # Warm at FULL concurrency so every pow2 lane-count kernel the
-        # timed phase can hit (batch lanes pad to pow2) is compiled
-        # before the clock starts.
-        run_all(srv, warm_payloads)
-        assert not errors, errors
-        counts = [0] * clients
-        dt = run_all(srv, payloads)
-    assert not errors, errors
-    assert all(c > 0 for c in counts)
-    gib = clients * n / dt / (1 << 30)
-    print(json.dumps({
-        "metric": "service_concurrent_chunkhash",
-        "value": round(gib, 3),
-        "unit": "GiB/s",
-        "clients": clients,
-        "mib_per_client": mib,
-        "segment_kib": seg_kib,
-        "backend": jax.default_backend(),
-        "chunks": sum(counts),
-    }))
+    tenants = parse_tenants(env_str(
+        "VOLSYNC_SVCBENCH_TENANTS", "gold:4:2;bronze:1:2"))
+    kwargs = dict(
+        tenants=tenants,
+        requests_per_client=env_int("VOLSYNC_SVCBENCH_REQUESTS", 3),
+        mib_per_request=env_int("VOLSYNC_SVCBENCH_MIB", 16),
+        segment_kib=env_int("VOLSYNC_SVCBENCH_SEG_KIB", 4096),
+        window_ms=env_float("VOLSYNC_SVCBENCH_WINDOW_MS", 2.0),
+        max_streams=env_int("VOLSYNC_SVCBENCH_MAX_STREAMS", 0),
+        force_breaker=env_bool("VOLSYNC_SVCBENCH_FORCE_BREAKER"),
+        fault_spec=env_str("VOLSYNC_SVCBENCH_FAULT_SPEC", "") or "",
+        fault_seed=env_int("VOLSYNC_SVCBENCH_FAULT_SEED", 0),
+    )
+    if smoke:
+        kwargs.update(requests_per_client=2, mib_per_request=2,
+                      segment_kib=512)
+    result = run_closed_loop(**kwargs)
+    if smoke:
+        # the JSON contract the Makefile smoke target pins
+        for key in ("metric", "value", "unit", "tenants", "backend",
+                    "provenance"):
+            assert key in result, f"smoke: missing {key!r}"
+        assert result["provenance"].get("git_rev"), "smoke: provenance"
+        if not kwargs.get("force_breaker"):
+            assert result["mid_stream_aborts"] == [], \
+                result["mid_stream_aborts"]
+            assert result["requests_total"] == 2 * sum(
+                t["clients"] for t in tenants)
+    print(json.dumps(result))
     return 0
 
 

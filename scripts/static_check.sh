@@ -16,6 +16,9 @@
 #      module-level locks are instrumented too.
 #   3. A small-scale metadata-plane bench smoke (`bench.py index`) so
 #      the batched/sharded/prefiltered index paths stay runnable.
+#   4. The closed-loop service bench at smoke scale, which asserts its
+#      own JSON contract (per-tenant latencies, shed accounting,
+#      provenance) — the multi-tenant service plane stays runnable.
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -32,5 +35,8 @@ JAX_PLATFORMS=cpu VOLSYNC_TPU_LOCKCHECK=1 \
 
 echo "== bench-index-smoke =="
 make --no-print-directory bench-index-smoke > /dev/null
+
+echo "== service-bench-smoke =="
+make --no-print-directory service-bench-smoke > /dev/null
 
 echo "static_check: OK"

@@ -209,8 +209,13 @@ def test_chaos_concurrent_backups_share_one_repository(tmp_path):
             (src / f"f{i}.bin").write_bytes(
                 rng.bytes(100_000 + 17 * i + t))
         trees.append(src)
+    # p-only schedules can legitimately roll ZERO hits on a run this
+    # short (pack keys are salted per init, so rolls differ per run);
+    # the at=3 spec fires deterministically so the "schedule never
+    # fired" assert below cannot flake.
     fs, faults, top = _chaos_stack(tmp_path / "store", 111,
-                                   [FaultSpec(kind="transient", p=0.10)])
+                                   [FaultSpec(kind="transient", p=0.10),
+                                    FaultSpec(kind="transient", at=3)])
     Repository.init(fs, chunker=CHUNKER)
     repo = Repository.open(top)
     repo.PACK_TARGET = 64 * 1024

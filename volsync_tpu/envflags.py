@@ -172,6 +172,81 @@ def index_prefilter() -> bool:
     return env_bool("VOLSYNC_INDEX_PREFILTER", True)
 
 
+# -- multi-tenant service plane (service/admission.py, scheduler.py) -----
+
+def svc_max_streams() -> int:
+    """Global cap on concurrently admitted ChunkHash streams; the
+    stream that would exceed it is shed at admission with
+    RESOURCE_EXHAUSTED (never wedged mid-stream)."""
+    return env_int("VOLSYNC_SVC_MAX_STREAMS", 64, minimum=1)
+
+
+def svc_tenant_streams() -> int:
+    """Default per-tenant concurrent-stream cap (a TenantConfig
+    max_streams overrides it per tenant)."""
+    return env_int("VOLSYNC_SVC_TENANT_STREAMS", 16, minimum=1)
+
+
+def svc_max_queued() -> int:
+    """Global cap on segments queued in the service scheduler; new
+    streams are shed at admission while the backlog is at the cap."""
+    return env_int("VOLSYNC_SVC_MAX_QUEUED", 256, minimum=1)
+
+
+def svc_tenant_queued() -> int:
+    """Default per-tenant bound on scheduler-queued segments — the
+    credit pool behind the per-stream backpressure pause (a
+    TenantConfig max_queued overrides it per tenant)."""
+    return env_int("VOLSYNC_SVC_TENANT_QUEUED", 32, minimum=1)
+
+
+def svc_stream_credits() -> int:
+    """Segments' worth of request bytes one stream may buffer in the
+    server beyond the segment in flight before the handler stops
+    reading (gRPC flow control then pauses the sender)."""
+    return env_int("VOLSYNC_SVC_STREAM_CREDITS", 2, minimum=1)
+
+
+def svc_retry_after_ms() -> float:
+    """Base retry-after hint (milliseconds) stamped on quota sheds;
+    breaker sheds carry the breaker's remaining cooldown instead."""
+    return env_float("VOLSYNC_SVC_RETRY_AFTER_MS", 100.0, minimum=1.0)
+
+
+def svc_quantum() -> int:
+    """Deficit-round-robin quantum in bytes credited to each backlogged
+    tenant per scheduler round (multiplied by the tenant weight)."""
+    return env_int("VOLSYNC_SVC_QUANTUM", 256 * 1024, minimum=1)
+
+
+def svc_dispatch_window() -> int:
+    """Max scheduler-dispatched segments outstanding in the
+    microbatcher at once; 0 derives it from the batcher geometry
+    (max_batch * pipeline_depth)."""
+    return env_int("VOLSYNC_SVC_DISPATCH_WINDOW", 0, minimum=0)
+
+
+def svc_drain_seconds() -> float:
+    """How long stop() waits for in-flight streams to finish before
+    aborting the stragglers with UNAVAILABLE."""
+    return env_float("VOLSYNC_SVC_DRAIN_S", 10.0, minimum=0.0)
+
+
+def svc_tenants_spec() -> Optional[str]:
+    """VOLSYNC_SVC_TENANTS: per-tenant quota/weight spec, e.g.
+    ``gold:weight=4,streams=8,queued=64;bronze:weight=1`` (see
+    service/tenants.py parse rules); None = all tenants on defaults."""
+    return env_str("VOLSYNC_SVC_TENANTS")
+
+
+def svc_breaker_backend() -> Optional[str]:
+    """VOLSYNC_SVC_BREAKER_BACKEND: name of the resilience circuit
+    breaker the admission controller watches — while that breaker is
+    open, new streams shed at admission with the remaining cooldown as
+    the retry-after hint. None = no breaker wired."""
+    return env_str("VOLSYNC_SVC_BREAKER_BACKEND")
+
+
 # -- observability (obs/tracing.py) --------------------------------------
 
 def trace_dir() -> Optional[str]:

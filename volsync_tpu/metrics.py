@@ -106,6 +106,41 @@ class Metrics:
             "Max per-shard prefilter set-bit fraction (0..1)",
             registry=self.registry,
         )
+        # Multi-tenant service plane (service/admission.py,
+        # service/scheduler.py): per-tenant admission outcomes — every
+        # ChunkHash stream is either admitted or shed AT ADMISSION with
+        # a reason ("breaker_open", "global_streams", "tenant_streams",
+        # "overload", "draining") — plus the scheduler's per-tenant
+        # backlog and the queue-wait of the most recently dispatched
+        # segment. Tenant label values come from client metadata; the
+        # registry caps and sanitizes them so cardinality stays bounded
+        # by the set of names clients actually present.
+        self.svc_admitted = Counter(
+            "volsync_svc_admitted_total",
+            "ChunkHash streams admitted, by tenant",
+            ["tenant"], registry=self.registry,
+        )
+        self.svc_shed = Counter(
+            "volsync_svc_shed_total",
+            "ChunkHash streams shed at admission, by tenant and reason",
+            ["tenant", "reason"], registry=self.registry,
+        )
+        self.svc_active_streams = Gauge(
+            "volsync_svc_active_streams",
+            "Currently admitted ChunkHash streams, by tenant",
+            ["tenant"], registry=self.registry,
+        )
+        self.svc_queue_depth = Gauge(
+            "volsync_svc_queue_depth",
+            "Segments queued in the service scheduler, by tenant",
+            ["tenant"], registry=self.registry,
+        )
+        self.svc_sched_latency = Gauge(
+            "volsync_svc_sched_latency_seconds",
+            "Queue wait of the last segment the scheduler dispatched, "
+            "by tenant",
+            ["tenant"], registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":

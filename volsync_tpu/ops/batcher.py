@@ -20,6 +20,12 @@ from volsync_tpu.analysis import lockcheck
 from volsync_tpu.ops.gearcdc import GearParams
 
 
+class BatcherStopped(RuntimeError):
+    """submit() after stop(), or work stranded by shutdown. Typed so
+    the service layer can map it to a clean UNAVAILABLE instead of
+    pattern-matching a RuntimeError message."""
+
+
 class SegmentMicroBatcher:
     """Queue + worker thread: the first item waits up to ``window_ms``
     for companions (bounded by ``max_batch``), the batch dispatches via
@@ -64,14 +70,22 @@ class SegmentMicroBatcher:
 
     def submit(self, data: bytes, length: int, eof: bool):
         """Blocking: returns (chunks, consumed) for this segment."""
-        if self._stop.is_set():
-            raise RuntimeError("microbatcher stopped")
-        f: Future = Future()
-        self._q.put((data, length, eof, f))
         # The worker resolves every queued future (including at
         # shutdown); the timeout is a last-ditch liveness bound so a
         # producer thread can never hang the interpreter.
-        return f.result(timeout=600)
+        return self.submit_async(data, length, eof).result(timeout=600)
+
+    def submit_async(self, data: bytes, length: int, eof: bool) -> Future:
+        """Non-blocking enqueue: the future resolves with
+        (chunks, consumed) for this segment. The service scheduler
+        (service/scheduler.py) feeds the batcher through this so its
+        deficit-round-robin thread never blocks on a device round
+        trip."""
+        if self._stop.is_set():
+            raise BatcherStopped("microbatcher stopped")
+        f: Future = Future()
+        self._q.put((data, length, eof, f))
+        return f
 
     def _run(self):
         import time as time_mod
@@ -113,7 +127,7 @@ class SegmentMicroBatcher:
                 elif now >= stop_deadline:
                     break
             if not acquired:
-                exc = RuntimeError("microbatcher stopped")
+                exc = BatcherStopped("microbatcher stopped")
                 for _, _, _, f in batch:
                     if not f.done():
                         f.set_exception(exc)
@@ -163,7 +177,7 @@ class SegmentMicroBatcher:
             except queue.Empty:
                 break
             if not f.done():
-                f.set_exception(RuntimeError("microbatcher stopped"))
+                f.set_exception(BatcherStopped("microbatcher stopped"))
 
 
 _SHARED: dict = {}

@@ -306,6 +306,18 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    def open_remaining(self) -> float:
+        """Seconds left in the open-state cooldown; 0.0 when the
+        breaker is closed, half-open, or already due for its probe.
+        Load-shedding callers (service/admission.py) use this as the
+        retry-after hint — shedding at admission instead of discovering
+        the open breaker mid-stream as a timeout."""
+        with self._lock:
+            if self._state != "open":
+                return 0.0
+            return max(0.0,
+                       self._opened_at + self.reset_seconds - self._clock())
+
     def _transition(self, state: str):
         # caller holds self._lock
         if state == self._state:

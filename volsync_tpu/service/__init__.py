@@ -1,8 +1,35 @@
 """mover-jax: the TPU chunk/hash data plane as a gRPC service
-(BASELINE.json north star; SURVEY.md §2.3 communication backend).
+(BASELINE.json north star; SURVEY.md §2.3 communication backend),
+plus the multi-tenant service plane in front of it: admission control
+(service/admission.py), weighted deficit-round-robin scheduling
+(service/scheduler.py), and the tenancy model (service/tenants.py).
 """
 
-from volsync_tpu.service.client import MoverJaxClient, open_client
+from volsync_tpu.service.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    StreamTicket,
+)
+from volsync_tpu.service.client import MoverJaxClient, ShedError, open_client
+from volsync_tpu.service.scheduler import SchedulerStopped, SegmentScheduler
 from volsync_tpu.service.server import MoverJaxServer
+from volsync_tpu.service.tenants import (
+    TenantConfig,
+    TenantRegistry,
+    sanitize_tenant,
+)
 
-__all__ = ["MoverJaxServer", "MoverJaxClient", "open_client"]
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "MoverJaxClient",
+    "MoverJaxServer",
+    "SchedulerStopped",
+    "SegmentScheduler",
+    "ShedError",
+    "StreamTicket",
+    "TenantConfig",
+    "TenantRegistry",
+    "open_client",
+    "sanitize_tenant",
+]

@@ -3,7 +3,16 @@
 What a remote mover links against instead of a local engine: stream a
 volume (any ``reader(n)``) to the service and iterate finalized chunks;
 batch-hash spans; discover the serving backend. Every call carries the
-service token (server aborts UNAUTHENTICATED otherwise).
+service token (server aborts UNAUTHENTICATED otherwise) and, when
+given, an ``x-volsync-tenant`` claim so the service plane's admission
+controller and fair scheduler know whose quota the work bills to.
+
+When the server sheds a stream at admission (RESOURCE_EXHAUSTED with
+an ``x-volsync-retry-after-ms`` trailing-metadata hint), the raw
+grpc.RpcError is translated into :class:`ShedError` — a typed
+resilience.ThrottleError subclass carrying ``retry_after`` seconds —
+so callers (and RetryPolicy's classifier) see a throttle, not an
+opaque RPC failure.
 """
 
 from __future__ import annotations
@@ -12,18 +21,60 @@ from typing import Callable, Iterator, Optional
 
 import grpc
 
-from volsync_tpu.resilience import RetryPolicy
+from volsync_tpu.resilience import RetryPolicy, ThrottleError
 from volsync_tpu.service import moverjax_pb2 as pb
-from volsync_tpu.service.server import SERVICE_NAME, TOKEN_METADATA_KEY
+from volsync_tpu.service.server import (
+    RETRY_AFTER_METADATA_KEY,
+    SERVICE_NAME,
+    TOKEN_METADATA_KEY,
+)
+from volsync_tpu.service.tenants import TENANT_METADATA_KEY
 
 _SEND_CHUNK = 4 * 1024 * 1024
 
 
+class ShedError(ThrottleError):
+    """The service shed this call at admission. ``retry_after`` is the
+    server's hint in seconds (falls back to 0.1 when the trailing
+    metadata is missing). Subclasses ThrottleError so
+    resilience.classify treats a shed as retryable backpressure."""
+
+    def __init__(self, message: str, retry_after: float = 0.1):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+def shed_from_rpc(err: grpc.RpcError) -> Optional[ShedError]:
+    """RESOURCE_EXHAUSTED RpcError -> ShedError (else None), reading
+    the retry-after hint from trailing metadata. Exposed for tests and
+    for callers driving the raw stubs."""
+    code = getattr(err, "code", None)
+    if not callable(code) or code() != grpc.StatusCode.RESOURCE_EXHAUSTED:
+        return None
+    retry_after = 0.1
+    trailing = getattr(err, "trailing_metadata", None)
+    pairs = trailing() if callable(trailing) else None
+    for key, value in pairs or ():
+        if key == RETRY_AFTER_METADATA_KEY:
+            try:
+                retry_after = max(0.001, float(value) / 1000.0)
+            except ValueError:
+                pass  # unparsable hint: keep the default
+            break
+    details = getattr(err, "details", None)
+    message = details() if callable(details) else str(err)
+    return ShedError(message or "shed at admission", retry_after)
+
+
 class MoverJaxClient:
     def __init__(self, address: str, port: int, token: str,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, tenant: Optional[str] = None):
         self._channel = grpc.insecure_channel(f"{address}:{port}")
-        self._meta = ((TOKEN_METADATA_KEY, token),)
+        meta = [(TOKEN_METADATA_KEY, token)]
+        if tenant:
+            meta.append((TENANT_METADATA_KEY, tenant))
+        self._meta = tuple(meta)
+        self.tenant = tenant
         self._timeout = timeout
         # Unary calls retry under the shared policy (grpc.RpcError's
         # .code() is classified: UNAVAILABLE-family retries,
@@ -70,10 +121,17 @@ class MoverJaxClient:
                     return
                 yield pb.DataSegment(data=piece)
 
-        for batch in self._chunk_hash(segments(), metadata=self._meta,
-                                      timeout=self._timeout):
-            for c in batch.chunks:
-                yield int(c.offset), int(c.length), c.digest
+        call = self._chunk_hash(segments(), metadata=self._meta,
+                                timeout=self._timeout)
+        try:
+            for batch in call:
+                for c in batch.chunks:
+                    yield int(c.offset), int(c.length), c.digest
+        except grpc.RpcError as err:
+            shed = shed_from_rpc(err)
+            if shed is not None:
+                raise shed from err
+            raise
 
     def chunk_bytes(self, data: bytes) -> list[tuple[int, int, str]]:
         view = memoryview(data)
@@ -86,21 +144,34 @@ class MoverJaxClient:
 
         return list(self.chunk_stream(read))
 
+    def _unary(self, stub, request):
+        """Policy-wrapped unary call; sheds surface as ShedError (a
+        ThrottleError, so the policy retries them like any throttle,
+        and an exhausted deadline still carries the typed error)."""
+
+        def invoke():
+            try:
+                return stub(request, metadata=self._meta,
+                            timeout=self._timeout)
+            except grpc.RpcError as err:
+                shed = shed_from_rpc(err)
+                if shed is not None:
+                    raise shed from err
+                raise
+
+        return self._policy.call(invoke)
+
     def hash_spans(self, data: bytes,
                    spans: list[tuple[int, int]]) -> list[str]:
         req = pb.HashSpansRequest(data=data)
         for off, length in spans:
             req.spans.append(pb.Span(offset=off, length=length))
-        reply = self._policy.call(self._hash_spans, req,
-                                  metadata=self._meta,
-                                  timeout=self._timeout)
-        return list(reply.digests)
+        return list(self._unary(self._hash_spans, req).digests)
 
     def info(self) -> pb.InfoResponse:
-        return self._policy.call(self._info, pb.InfoRequest(),
-                                 metadata=self._meta,
-                                 timeout=self._timeout)
+        return self._unary(self._info, pb.InfoRequest())
 
 
-def open_client(address: str, port: int, token: str) -> MoverJaxClient:
-    return MoverJaxClient(address, port, token)
+def open_client(address: str, port: int, token: str,
+                tenant: Optional[str] = None) -> MoverJaxClient:
+    return MoverJaxClient(address, port, token, tenant=tenant)

@@ -1,0 +1,275 @@
+"""Weighted deficit-round-robin segment scheduler for the service plane.
+
+Without it, concurrent ChunkHash streams race each other straight into
+the SegmentMicroBatcher's FIFO: one greedy stream that always has a
+segment ready monopolizes device batch slots and starves everyone else
+of the coalescing win the batcher exists for. The scheduler puts a
+fairness stage in front: segments queue PER TENANT, and a collector
+thread runs classic deficit round robin (Shreedhar & Varghese) weighted
+by the tenant's configured share — each round every backlogged tenant
+earns ``quantum * weight`` bytes of credit and dispatches whole
+segments while its deficit covers them. Cross-tenant segments still
+land in the SAME microbatcher window, so fairness does not cost the
+single-dispatch coalescing (amortized pipeline warmup) the PR-1 path
+measures.
+
+Backpressure, not buffering: each tenant's queue is bounded
+(TenantConfig.max_queued / VOLSYNC_SVC_TENANT_QUEUED). ``submit``
+blocks on the tenant's credit semaphore when the queue is full, which
+pauses the gRPC handler thread, which stops pulling the request
+iterator, which lets gRPC flow control push back on the sender — a
+slow device never turns into unbounded server memory. Dispatches into
+the batcher are themselves windowed (``dispatch_window``) so the
+scheduler cannot flood the batcher queue and recreate the FIFO it
+replaced.
+
+Observability: ``volsync_svc_queue_depth{tenant}`` tracks backlog,
+``volsync_svc_sched_latency_seconds{tenant}`` the queue wait of the
+most recently dispatched segment, and each dispatch runs under a
+``svc.schedule`` span.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from concurrent.futures import Future
+
+from volsync_tpu import envflags
+from volsync_tpu.analysis import lockcheck
+from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
+from volsync_tpu.obs import span
+from volsync_tpu.service.tenants import TenantRegistry
+
+
+class SchedulerStopped(RuntimeError):
+    """Work refused or stranded because the scheduler is shutting
+    down; the server maps it to a clean UNAVAILABLE."""
+
+
+@dataclass
+class _Item:
+    data: bytes
+    length: int
+    eof: bool
+    future: Future
+    tenant: str
+    enqueued_at: float
+    cost: int  # bytes (>= 1 so empty eof flushes still cost a unit)
+
+
+@dataclass
+class _TenantState:
+    weight: int
+    credits: threading.Semaphore
+    q: deque = field(default_factory=deque)
+    deficit: float = 0.0
+    depth_gauge: object = None
+    latency_gauge: object = None
+
+
+class SegmentScheduler:
+    """Fair, bounded feeder between stream handlers and one
+    SegmentMicroBatcher.
+
+    ``start=False`` leaves the collector thread unstarted so tests can
+    drive :meth:`service_round` deterministically."""
+
+    def __init__(self, batcher, registry: TenantRegistry, *,
+                 quantum: Optional[int] = None,
+                 tenant_queued: Optional[int] = None,
+                 dispatch_window: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 start: bool = True):
+        self._batcher = batcher
+        self._registry = registry
+        self._quantum = (envflags.svc_quantum() if quantum is None
+                         else max(1, quantum))
+        self._tenant_queued = (envflags.svc_tenant_queued()
+                               if tenant_queued is None
+                               else max(1, tenant_queued))
+        if dispatch_window is None:
+            dispatch_window = envflags.svc_dispatch_window()
+        if dispatch_window <= 0:
+            # derive from batcher geometry: enough outstanding segments
+            # to fill every in-flight batch, plus one window forming
+            depth = getattr(batcher, "_depth", 1)
+            max_batch = getattr(batcher, "_max_batch", 16)
+            dispatch_window = max_batch * (depth + 1)
+        self._clock = clock
+        self._lock = lockcheck.make_lock("service.scheduler")
+        self._states: dict[str, _TenantState] = {}
+        self._order: list[str] = []
+        self._slots = threading.BoundedSemaphore(dispatch_window)
+        self.dispatch_window = dispatch_window
+        self._queued = 0
+        self._dispatched = 0
+        self._work = threading.Event()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="svc-scheduler")
+        if start:
+            self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+
+    def _state_for(self, tenant: str) -> _TenantState:
+        # caller does NOT hold the lock
+        with self._lock:
+            st = self._states.get(tenant)
+            if st is None:
+                cfg = self._registry.config(tenant)
+                bound = (cfg.max_queued if cfg.max_queued is not None
+                         else self._tenant_queued)
+                st = _TenantState(
+                    weight=cfg.weight,
+                    credits=threading.Semaphore(bound),
+                    depth_gauge=GLOBAL_METRICS.svc_queue_depth.labels(
+                        tenant=tenant),
+                    latency_gauge=GLOBAL_METRICS.svc_sched_latency.labels(
+                        tenant=tenant))
+                self._states[tenant] = st
+                self._order.append(tenant)
+            return st
+
+    def submit(self, tenant: str, data: bytes, length: int,
+               eof: bool) -> Future:
+        """Enqueue one segment; the future resolves with the batcher's
+        (chunks, consumed). Blocks — the credit-based pause — while the
+        tenant's queue is at its bound."""
+        st = self._state_for(tenant)
+        while not st.credits.acquire(timeout=0.1):
+            if self._stopped.is_set():
+                raise SchedulerStopped("scheduler stopped")
+        if self._stopped.is_set():
+            st.credits.release()
+            raise SchedulerStopped("scheduler stopped")
+        item = _Item(data=data, length=length, eof=eof, future=Future(),
+                     tenant=tenant, enqueued_at=self._clock(),
+                     cost=max(1, length))
+        with self._lock:
+            st.q.append(item)
+            self._queued += 1
+            depth = len(st.q)
+        st.depth_gauge.set(depth)
+        self._work.set()
+        return item.future
+
+    def queued_total(self) -> int:
+        """Segments waiting for a dispatch slot (the admission
+        controller's overload signal)."""
+        with self._lock:
+            return self._queued
+
+    @property
+    def dispatched_total(self) -> int:
+        with self._lock:
+            return self._dispatched
+
+    # -- collector side ----------------------------------------------------
+
+    def service_round(self) -> bool:
+        """One deficit-round-robin pass over all backlogged tenants.
+        Returns False when there was nothing to do."""
+        with self._lock:
+            actives = [n for n in self._order if self._states[n].q]
+        if not actives:
+            return False
+        for name in actives:
+            st = self._states[name]
+            ready: list[_Item] = []
+            with self._lock:
+                if not st.q:
+                    st.deficit = 0.0
+                    continue
+                st.deficit += float(self._quantum) * st.weight
+                while st.q and st.q[0].cost <= st.deficit:
+                    item = st.q.popleft()
+                    st.deficit -= item.cost
+                    self._queued -= 1
+                    ready.append(item)
+                if not st.q:
+                    # standard DRR: an emptied queue forfeits leftover
+                    # deficit (no banking credit while idle)
+                    st.deficit = 0.0
+                depth = len(st.q)
+            st.depth_gauge.set(depth)
+            for item in ready:
+                st.credits.release()
+                self._dispatch(st, item)
+        return True
+
+    def _dispatch(self, st: _TenantState, item: _Item) -> None:
+        # windowed handoff to the batcher: wait for a slot, interrupted
+        # by stop (stranded items are failed, never lost)
+        while not self._slots.acquire(timeout=0.1):
+            if self._stopped.is_set():
+                if not item.future.done():
+                    item.future.set_exception(
+                        SchedulerStopped("scheduler stopped"))
+                return
+        st.latency_gauge.set(self._clock() - item.enqueued_at)
+        with self._lock:
+            self._dispatched += 1
+        try:
+            with span("svc.schedule"):
+                inner = self._batcher.submit_async(
+                    item.data, item.length, item.eof)
+        except BaseException as exc:
+            self._slots.release()
+            if not item.future.done():
+                item.future.set_exception(exc)
+            return
+
+        def _chain(done: Future, out: Future = item.future) -> None:
+            self._slots.release()
+            if out.done():
+                return
+            exc = done.exception()
+            if exc is not None:
+                out.set_exception(exc)
+            else:
+                out.set_result(done.result())
+
+        inner.add_done_callback(_chain)
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            if self.service_round():
+                continue
+            # empty: sleep until a submit signals work. Clear FIRST,
+            # then re-check, so a submit racing the clear is never lost.
+            self._work.clear()
+            with self._lock:
+                backlog = self._queued
+            if backlog:
+                continue
+            self._work.wait(0.2)
+
+    def stop(self) -> None:
+        """Stop the collector and fail everything still queued with
+        SchedulerStopped (handlers map it to UNAVAILABLE). Call AFTER
+        the server's drain window — an orderly shutdown reaches here
+        with empty queues."""
+        self._stopped.set()
+        self._work.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+        stranded: list[_Item] = []
+        with self._lock:
+            for st in self._states.values():
+                while st.q:
+                    stranded.append(st.q.popleft())
+                    self._queued -= 1
+                st.deficit = 0.0
+        for item in stranded:
+            st = self._states[item.tenant]
+            st.credits.release()
+            st.depth_gauge.set(0)
+            if not item.future.done():
+                item.future.set_exception(
+                    SchedulerStopped("scheduler stopped"))

@@ -13,9 +13,27 @@ loops run on the accelerator (engine/chunker.py). Service surface:
 
 Security keeps the reference's envelope (mutually-known secret +
 restricted verb surface — rsync_common.go's keyed channel): every call
-must carry the service token in ``x-volsync-token`` metadata; anything
-else is UNAUTHENTICATED. The method table is closed — gRPC generic
-handlers register exactly these three methods.
+must carry a bearer token in ``x-volsync-token`` metadata — the shared
+service token, or the calling tenant's own token when its TenantConfig
+pins one (service/tenants.py). Comparison is constant-time
+(hmac.compare_digest); anything else is UNAUTHENTICATED. The method
+table is closed — gRPC generic handlers register exactly these three
+methods.
+
+Multi-tenant service plane (service/admission.py, scheduler.py,
+tenants.py): every ChunkHash stream is admission-controlled before any
+byte is read — global and per-tenant stream caps, a scheduler-backlog
+gate, and an immediate shed while the wired resilience circuit breaker
+is open — and admitted streams' segments flow through a weighted
+deficit-round-robin scheduler into the shared SegmentMicroBatcher, so
+one greedy stream cannot starve other tenants of device batch slots
+while cross-tenant segments still coalesce into single dispatches.
+Sheds surface as ``RESOURCE_EXHAUSTED`` with an
+``x-volsync-retry-after-ms`` trailing-metadata hint (``UNAVAILABLE``
+while draining). Within a stream, a credit-based pause bounds how many
+request bytes the server buffers beyond the segment in flight — a slow
+device pushes back through gRPC flow control instead of growing server
+memory.
 
 Service stubs are hand-wired over protoc-generated messages
 (grpc_tools is not vendored; grpc's generic-handler API needs only the
@@ -27,19 +45,28 @@ from __future__ import annotations
 import hmac
 import logging
 import os
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
 import grpc
 import numpy as np
 
-from volsync_tpu.ops.batcher import SegmentMicroBatcher
+from volsync_tpu import envflags
+from volsync_tpu.ops.batcher import BatcherStopped, SegmentMicroBatcher
 from volsync_tpu.service import moverjax_pb2 as pb
+from volsync_tpu.service.admission import (
+    AdmissionController,
+    AdmissionRejected,
+)
+from volsync_tpu.service.scheduler import SchedulerStopped, SegmentScheduler
+from volsync_tpu.service.tenants import TenantRegistry
 
 log = logging.getLogger("volsync_tpu.moverjax")
 
 SERVICE_NAME = "moverjax.MoverJax"
 TOKEN_METADATA_KEY = "x-volsync-token"
+#: trailing-metadata key carrying the shed retry-after hint (ms)
+RETRY_AFTER_METADATA_KEY = "x-volsync-retry-after-ms"
 
 #: Stream segmentation mirrors engine/chunker.stream_chunks: a segment is
 #: processed once at least this much beyond max_size is buffered.
@@ -47,18 +74,38 @@ DEFAULT_SEGMENT_SIZE = 32 * 1024 * 1024
 
 
 class _TokenInterceptor(grpc.ServerInterceptor):
-    def __init__(self, token: str):
-        self._token = token.encode()
-        self._deny = grpc.unary_unary_rpc_method_handler(self._refuse)
+    """Constant-time bearer-token check, tenant-scoped: a tenant with
+    its own token must present it; everyone else presents the service
+    token. The deny handler matches the method's cardinality (a
+    stream-stream call refused with a unary handler draws an opaque
+    internal error instead of UNAUTHENTICATED)."""
 
-    def _refuse(self, request, context):
+    def __init__(self, token: str, registry: TenantRegistry):
+        self._token = token.encode()
+        self._registry = registry
+        self._deny_unary = grpc.unary_unary_rpc_method_handler(
+            self._refuse_unary)
+        self._deny_stream = grpc.stream_stream_rpc_method_handler(
+            self._refuse_stream)
+
+    def _refuse_unary(self, request, context):
         context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad service token")
+
+    def _refuse_stream(self, request_iterator, context):
+        context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad service token")
+        yield  # pragma: no cover — abort raises; this makes a generator
 
     def intercept_service(self, continuation, handler_call_details):
         meta = dict(handler_call_details.invocation_metadata)
+        tenant = self._registry.resolve(meta)
+        scoped = self._registry.token_for(tenant)
+        expected = scoped.encode() if scoped is not None else self._token
         supplied = str(meta.get(TOKEN_METADATA_KEY, "")).encode()
-        if not hmac.compare_digest(supplied, self._token):
-            return self._deny
+        if not hmac.compare_digest(supplied, expected):
+            method = handler_call_details.method or ""
+            if method.rsplit("/", 1)[-1] == "ChunkHash":
+                return self._deny_stream
+            return self._deny_unary
         return continuation(handler_call_details)
 
 
@@ -68,13 +115,27 @@ class MoverJaxServer:
 
     ``batch_window_ms > 0`` (default) coalesces concurrent streams'
     segments into single device dispatches via SegmentMicroBatcher;
-    0 keeps the per-request dispatch path."""
+    0 keeps the per-request dispatch path.
+
+    ``tenants``/``max_streams``/``tenant_streams``/``max_queued``
+    configure the admission controller (defaults from VOLSYNC_SVC_*).
+    ``breaker`` wires load-shedding to a resilience circuit breaker —
+    pass a CircuitBreaker, a backend name (resolved via breaker_for),
+    or leave None to follow VOLSYNC_SVC_BREAKER_BACKEND."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None, params=None,
                  segment_size: int = DEFAULT_SEGMENT_SIZE,
                  max_workers: int = 8, batch_window_ms: float = 2.0,
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 tenants: Optional[TenantRegistry] = None,
+                 admission: Optional[AdmissionController] = None,
+                 breaker=None,
+                 max_streams: Optional[int] = None,
+                 tenant_streams: Optional[int] = None,
+                 max_queued: Optional[int] = None,
+                 stream_credits: Optional[int] = None,
+                 scheduler_quantum: Optional[int] = None):
         from volsync_tpu.engine.chunker import DeviceChunkHasher
         from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS
 
@@ -89,12 +150,40 @@ class MoverJaxServer:
         self._batcher = None
         if batch_window_ms > 0 and self.params.align == 4096:
             if pipeline_depth is None:
-                from volsync_tpu import envflags
-
                 pipeline_depth = envflags.batch_pipeline_depth()
             self._batcher = SegmentMicroBatcher(
                 self.params, window_ms=batch_window_ms,
                 max_batch=max_workers, pipeline_depth=pipeline_depth)
+
+        self.tenants = tenants if tenants is not None \
+            else TenantRegistry.from_env()
+        # The WDRR scheduler rides the batcher; the per-request dispatch
+        # path (batch_window_ms=0 or unaligned params) keeps its direct
+        # per-handler dispatch and is still admission-gated.
+        self._scheduler = None
+        if self._batcher is not None:
+            self._scheduler = SegmentScheduler(
+                self._batcher, self.tenants, quantum=scheduler_quantum)
+        if isinstance(breaker, str):
+            from volsync_tpu.resilience import breaker_for
+
+            breaker = breaker_for(breaker)
+        elif breaker is None:
+            backend = envflags.svc_breaker_backend()
+            if backend:
+                from volsync_tpu.resilience import breaker_for
+
+                breaker = breaker_for(backend)
+        self._admission = admission if admission is not None else \
+            AdmissionController(
+                self.tenants, max_streams=max_streams,
+                tenant_streams=tenant_streams, max_queued=max_queued,
+                breaker=breaker,
+                queue_depth_fn=(self._scheduler.queued_total
+                                if self._scheduler is not None else None))
+        self._stream_credits = (envflags.svc_stream_credits()
+                                if stream_credits is None
+                                else max(1, stream_credits))
 
         serialize = lambda m: m.SerializeToString()  # noqa: E731
         handlers = {
@@ -107,13 +196,21 @@ class MoverJaxServer:
         }
         self._server = grpc.server(
             ThreadPoolExecutor(max_workers=max_workers),
-            interceptors=[_TokenInterceptor(self.token)],
+            interceptors=[_TokenInterceptor(self.token, self.tenants)],
         )
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),
         ))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host
+
+    @property
+    def admission(self) -> AdmissionController:
+        return self._admission
+
+    @property
+    def scheduler(self) -> Optional[SegmentScheduler]:
+        return self._scheduler
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -122,7 +219,27 @@ class MoverJaxServer:
         log.info("mover-jax serving on %s:%d", self.host, self.port)
         return self
 
-    def stop(self, grace: float = 2.0):
+    def stop(self, grace: float = 2.0, drain: Optional[float] = None):
+        """Drain-then-stop, deterministically ordered:
+
+        1. close admission — new streams shed with UNAVAILABLE;
+        2. wait up to ``drain`` (VOLSYNC_SVC_DRAIN_S) for in-flight
+           streams to finish on their own;
+        3. stop the scheduler — stragglers' pending segments fail with
+           SchedulerStopped, which their handlers surface as a clean
+           UNAVAILABLE (never a half-written final batch);
+        4. stop the gRPC server (bounded ``grace``), then the batcher.
+        """
+        if drain is None:
+            drain = envflags.svc_drain_seconds()
+        self._admission.begin_drain()
+        drained = self._admission.wait_idle(drain)
+        if not drained:
+            log.warning("mover-jax stop: %d stream(s) still in flight "
+                        "after %.1fs drain; aborting them",
+                        self._admission.active_streams(), drain)
+        if self._scheduler is not None:
+            self._scheduler.stop()
         self._server.stop(grace).wait()
         if self._batcher is not None:
             self._batcher.stop()
@@ -136,24 +253,68 @@ class MoverJaxServer:
     # -- methods -------------------------------------------------------------
 
     def _chunk_hash(self, request_iterator, context):
-        """Streaming CDC over the call: identical carry-the-tail protocol
-        to engine/chunker.stream_chunks, so a remote stream chunks
+        """Admission-gated streaming CDC: tenant resolution + admission
+        BEFORE the first byte is read, then the carry-the-tail protocol
+        of engine/chunker.stream_chunks — a remote stream chunks
         bit-identically to a local scan of the same bytes."""
+        meta = dict(context.invocation_metadata())
+        tenant = self._admission.tenant_from(meta)
+        try:
+            ticket = self._admission.admit_stream(tenant)
+        except AdmissionRejected as rej:
+            context.set_trailing_metadata((
+                (RETRY_AFTER_METADATA_KEY,
+                 str(max(1, int(rej.retry_after * 1000)))),))
+            code = (grpc.StatusCode.UNAVAILABLE if rej.reason == "draining"
+                    else grpc.StatusCode.RESOURCE_EXHAUSTED)
+            context.abort(code, str(rej))
+            return  # pragma: no cover — abort raises
+        try:
+            yield from self._serve_stream(request_iterator, ticket)
+        except (SchedulerStopped, BatcherStopped):
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "server shutting down")
+        finally:
+            self._admission.release(ticket)
+
+    def _submit_segment(self, ticket, data: bytes, eof: bool) -> Future:
+        """One segment into the scheduler (fair, windowed) or the
+        direct dispatch path; the future resolves with
+        (chunks, consumed_hint)."""
+        if self._scheduler is not None:
+            return self._scheduler.submit(ticket.tenant, data,
+                                          len(data), eof)
+        f: Future = Future()
+        try:
+            if self._batcher is not None:
+                f.set_result(self._batcher.submit(data, len(data), eof))
+            else:
+                out = self._hasher.process(
+                    np.frombuffer(data, np.uint8), eof=eof)
+                f.set_result((out, 0))
+        except BaseException as exc:
+            f.set_exception(exc)
+        return f
+
+    def _serve_stream(self, request_iterator, ticket):
+        """The streaming loop, with a credit-based pause: while one
+        segment is in flight on the device, the handler keeps reading
+        request bytes only up to ``stream_credits`` further segments'
+        worth — past that it blocks on the in-flight result, gRPC flow
+        control pauses the sender, and server-side buffering stays
+        bounded no matter how slow the device or how greedy the
+        client."""
         pending = bytearray()  # amortized append; bytes += would be O(n^2)
         base = 0
         p = self.params
+        cut = self.segment_size + p.max_size
+        credit_bytes = self._stream_credits * cut
+        inflight: Optional[tuple[Future, bool]] = None
 
-        def flush(eof: bool) -> pb.ChunkBatch:
+        def collect(handle) -> pb.ChunkBatch:
             nonlocal base
-            if self._batcher is not None:
-                # concurrent streams' segments coalesce into one
-                # device dispatch (lane-for-lane identical results —
-                # tests/test_batched_segments.py)
-                out, _ = self._batcher.submit(bytes(pending),
-                                              len(pending), eof)
-            else:
-                out = self._hasher.process(
-                    np.frombuffer(bytes(pending), np.uint8), eof=eof)
+            fut, eof = handle
+            out, _ = fut.result(timeout=600)
             batch = pb.ChunkBatch(final=eof)
             consumed = 0
             for start, length, digest in out:
@@ -164,17 +325,40 @@ class MoverJaxServer:
             del pending[:consumed]  # keep only the carried tail
             return batch
 
+        def flush(eof: bool) -> tuple[Future, bool]:
+            # a snapshot of the WHOLE buffer: appends that land while
+            # the device works don't disturb the consumed prefix
+            return (self._submit_segment(ticket, bytes(pending), eof), eof)
+
         for seg in request_iterator:
             if seg.data:
                 pending += seg.data
-            while len(pending) >= self.segment_size + p.max_size:
-                yield flush(False)
+            if inflight is not None and inflight[0].done():
+                yield collect(inflight)
+                inflight = None
+            if inflight is None and len(pending) >= cut:
+                inflight = flush(False)
+            while inflight is not None and len(pending) >= credit_bytes:
+                # credits exhausted: stop reading, wait out the device
+                yield collect(inflight)
+                inflight = None
+                if len(pending) >= cut:
+                    inflight = flush(False)
+            if inflight is not None:
+                ticket.buffered_high_water = max(
+                    ticket.buffered_high_water, len(pending))
             if seg.eof:
-                yield flush(True)
+                if inflight is not None:
+                    yield collect(inflight)
+                    inflight = None
+                yield collect(flush(True))
                 return
         # Stream ended without an eof marker: finalize what we have
         # (client disconnect mid-stream just drops the call).
-        yield flush(True)
+        if inflight is not None:
+            yield collect(inflight)
+            inflight = None
+        yield collect(flush(True))
 
     def _hash_spans(self, request: pb.HashSpansRequest, context):
         from volsync_tpu.engine.chunker import hash_spans
