@@ -3,10 +3,10 @@
 # tier-1 command in ROADMAP.md.
 
 .PHONY: lint test chaos static-check bench-index-smoke \
-	service-bench-smoke clean-lint
+	service-bench-smoke trace-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
-# all rule families, VL001-VL005 + VL105 per-file + VL101-VL104
+# all rule families, VL001-VL005 + VL105 + VL301 per-file + VL101-VL104
 # interprocedural + VL201-VL205 shape/dtype abstract interpretation, no
 # baseline. Warm runs re-analyze zero files; see docs/development.md.
 lint:
@@ -42,6 +42,13 @@ bench-index-smoke:
 # accounting, provenance block) so the bench stays runnable.
 service-bench-smoke:
 	VOLSYNC_SVCBENCH_SMOKE=1 python scripts/service_bench.py
+
+# Flight-recorder gate (docs/observability.md): a tiny pipelined backup
+# under a tenant-tagged trace must export a Perfetto-loadable
+# Chrome-trace-event dump (span shape, trace/tenant tags, parent/child
+# edges, thread names, trigger annotation).
+trace-smoke:
+	JAX_PLATFORMS=cpu python scripts/trace_smoke.py
 
 clean-lint:
 	rm -f lint.sarif .lint-cache

@@ -956,7 +956,13 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
     the finished repository."""
     from volsync_tpu.engine.chunker import stream_chunk_batches
     from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
-    from volsync_tpu.obs import reset_spans, span_totals
+    from volsync_tpu.obs import (
+        dump_trace,
+        reset_spans,
+        reset_trace,
+        span_totals,
+        trace_context,
+    )
     from volsync_tpu.ops.gearcdc import GearParams
     from volsync_tpu.repo.repository import Repository
 
@@ -1001,20 +1007,23 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
             return piece
 
         reset_spans()
+        reset_trace()
         ids: list = []
         t0 = time.perf_counter()
-        for chunks in stream_chunk_batches(
-                reader, params, segment_size=seg_size,
-                hasher=_HostSegmentHasher(),
-                readahead=(2 if pipelined else 0)):
-            if pipelined:
-                repo.add_blobs(
-                    "data", [(digest, chunk) for chunk, digest in chunks])
-            else:
-                for chunk, digest in chunks:
-                    repo.add_blob("data", digest, chunk)
-            ids.extend(digest for _, digest in chunks)
-        repo.flush()
+        with trace_context(tenant="bench"):
+            for chunks in stream_chunk_batches(
+                    reader, params, segment_size=seg_size,
+                    hasher=_HostSegmentHasher(),
+                    readahead=(2 if pipelined else 0)):
+                if pipelined:
+                    repo.add_blobs(
+                        "data",
+                        [(digest, chunk) for chunk, digest in chunks])
+                else:
+                    for chunk, digest in chunks:
+                        repo.add_blob("data", digest, chunk)
+                ids.extend(digest for _, digest in chunks)
+            repo.flush()
         elapsed = time.perf_counter() - t0
         injected = (len(repo.store.inner.injected)
                     if fault_seed is not None else 0)
@@ -1081,7 +1090,15 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
         "stages": stages(pipe_spans),
         "stages_serial": stages(serial_spans),
         "dedup_compare": dedup_compare(pipe_repo, pipe_ids),
-        "provenance": bench_provenance(),
+        # ROADMAP item 1 follow-on: every bench JSON self-describes
+        # where its time went. The flight recorder still holds the
+        # pipelined (last) run; trace_file is null unless
+        # VOLSYNC_TRACE_DUMP names a directory to export into.
+        "provenance": bench_provenance(extra={"trace": {
+            "spans": {name: {"count": c, "seconds": round(s, 4)}
+                      for name, (c, s) in sorted(pipe_spans.items())},
+            "trace_file": dump_trace(trigger="bench_pipeline"),
+        }}),
     }
     if fault_seed is not None:
         result["fault_seed"] = fault_seed

@@ -210,6 +210,8 @@ def run_closed_loop(*, tenants: list[dict], requests_per_client: int = 3,
     directly). ``tenants`` is [{name, weight, clients[, streams]}, ...];
     0 for any cap means "use the VOLSYNC_SVC_* default"."""
     from bench import bench_provenance
+    from volsync_tpu.obs import (
+        dump_trace, reset_spans, reset_trace, span_totals)
     from volsync_tpu.ops.gearcdc import GearParams
     from volsync_tpu.repo import blobid
     from volsync_tpu.resilience import CircuitBreaker, TransientError
@@ -300,6 +302,11 @@ def run_closed_loop(*, tenants: list[dict], requests_per_client: int = 3,
                     assert not aborts, aborts
                     tallies = {t["name"]: _TenantTally()
                                for t in tenants}
+                # Per-tenant stage attribution must describe the TIMED
+                # phase only — drop warm-phase spans and the warm
+                # flight-recorder contents before measuring.
+                reset_spans()
+                reset_trace()
                 dispatch_log.clear()
                 wall = _run_clients(make_client, tenants,
                                     lambda i: payloads[i],
@@ -311,7 +318,14 @@ def run_closed_loop(*, tenants: list[dict], requests_per_client: int = 3,
     import jax
 
     result["backend"] = jax.default_backend()
-    result["provenance"] = bench_provenance()
+    # Every BENCH_*.json self-describes where its time went (ROADMAP
+    # item 1 follow-on): span summary inline, plus the flight-recorder
+    # file when VOLSYNC_TRACE_DUMP names a directory to export into.
+    result["provenance"] = bench_provenance(extra={"trace": {
+        "spans": {name: {"count": c, "seconds": round(s, 4)}
+                  for name, (c, s) in sorted(span_totals().items())},
+        "trace_file": dump_trace(trigger="service_bench"),
+    }})
     return result
 
 
@@ -351,8 +365,19 @@ def _breaker_shed_phase(srv, make_client) -> dict:
     }
 
 
+# The non-overlapping server-side components of one stream: admission
+# gate, DRR queue wait, device batch (svc.schedule and svc.stream
+# enclose/overlap these, client.chunk_stream is the client's view —
+# all reported in stages_s but excluded from the coverage sum so no
+# second is counted twice).
+_COMPONENT_STAGES = ("svc.admit", "svc.queue_wait", "svc.batch")
+
+
 def _report_load_phase(tenants: list[dict], tallies: dict, wall: float,
                        dispatch_log: list) -> dict:
+    from volsync_tpu.obs import stage_seconds_by_tenant
+
+    tenant_stages = stage_seconds_by_tenant()
     per_tenant: dict = {}
     total_bytes = 0
     admitted = sheds = 0
@@ -363,15 +388,27 @@ def _report_load_phase(tenants: list[dict], tallies: dict, wall: float,
         admitted += tl.requests
         sheds += tl.sheds
         aborts.extend(tl.mid_stream_aborts)
+        stages = {stage: round(secs, 4)
+                  for (tn, stage), secs in sorted(tenant_stages.items())
+                  if tn == t["name"]}
+        p50_s = _percentile(tl.latencies, 50)
+        comp = sum(stages.get(s, 0.0) for s in _COMPONENT_STAGES)
         per_tenant[t["name"]] = {
             "weight": t["weight"],
             "clients": t["clients"],
             "requests": tl.requests,
             "shed": tl.sheds,
-            "p50_ms": round(_percentile(tl.latencies, 50) * 1e3, 2),
+            "p50_ms": round(p50_s * 1e3, 2),
             "p99_ms": round(_percentile(tl.latencies, 99) * 1e3, 2),
             "goodput_gibs": round(tl.bytes / wall / (1 << 30), 3)
             if wall > 0 else 0.0,
+            # where each tenant's time went (seconds summed over the
+            # timed phase, from the tenant-tagged span registry)
+            "stages_s": stages,
+            # mean per-request component time over the measured p50:
+            # >= 0.9 means the breakdown accounts for the latency
+            "stage_coverage": round(comp / tl.requests / p50_s, 3)
+            if tl.requests and p50_s > 0 else 0.0,
         }
     segments = sum(dispatch_log)
     return {

@@ -4,8 +4,9 @@
 #   1. `volsync lint` over the whole tree — package, scripts/ and
 #      bench.py — must be clean with no baseline, with every rule
 #      family enabled: the per-file VL001-VL005 checks plus VL105
-#      (ad-hoc retry sleeps outside resilience.py), the
-#      interprocedural VL101-VL104 family, and the VL201-VL205
+#      (ad-hoc retry sleeps outside resilience.py) and VL301 (span
+#      names must be literal dotted lowercase), the interprocedural
+#      VL101-VL104 family, and the VL201-VL205
 #      shape/dtype abstract interpreter
 #      (tests/test_analysis.py enforces the same in tier-1). Emits a
 #      SARIF 2.1.0 report to lint.sarif for CI upload and uses the
@@ -19,6 +20,9 @@
 #   4. The closed-loop service bench at smoke scale, which asserts its
 #      own JSON contract (per-tenant latencies, shed accounting,
 #      provenance) — the multi-tenant service plane stays runnable.
+#   5. The flight-recorder smoke (`make trace-smoke`): a tiny pipeline
+#      run must export a Perfetto-loadable Chrome-trace-event dump
+#      (docs/observability.md).
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -38,5 +42,8 @@ make --no-print-directory bench-index-smoke > /dev/null
 
 echo "== service-bench-smoke =="
 make --no-print-directory service-bench-smoke > /dev/null
+
+echo "== trace-smoke =="
+make --no-print-directory trace-smoke
 
 echo "static_check: OK"

@@ -1,5 +1,6 @@
 """The analyzer analyzed: seeded-violation fixtures per rule (per-file
-VL001-VL005 and interprocedural VL101-VL104), call-graph resolution
+VL001-VL005/VL105/VL301 and interprocedural VL101-VL104), call-graph
+resolution
 over the committed mini-package in ``analysis_fixtures/``, baseline
 add/expire, suppression comments, SARIF emission, the incremental
 cache, and the tier-1 gate — `volsync lint` runs clean over the
@@ -198,6 +199,54 @@ def test_vl105_suppression(tmp_path):
            "        pass\n"
            "    time.sleep(1)  # lint: ignore[VL105] — paced poll\n")
     assert _lint_file(tmp_path, src) == []
+
+
+def test_vl301_dynamic_span_names_flagged(tmp_path):
+    src = (
+        "from volsync_tpu.obs import begin_span, span\n"
+        "from volsync_tpu import obs\n"
+        "stage = 'read'\n"
+        "with span(f'engine.{stage}'):\n"      # f-string
+        "    pass\n"
+        "with span('engine.' + stage):\n"      # concatenation
+        "    pass\n"
+        "with span(stage):\n"                  # variable
+        "    pass\n"
+        "with span('Bad.Name'):\n"             # not lowercase
+        "    pass\n"
+        "with obs.span('flat'):\n"             # no dot: not component.stage
+        "    pass\n"
+        "h = begin_span(name=stage)\n"         # name= kwarg, variable
+    )
+    findings = _lint_file(tmp_path, src)
+    assert _codes(findings) == ["VL301"] * 6
+    assert {f.line for f in findings} == {4, 6, 8, 10, 12, 14}
+
+
+def test_vl301_clean_twin(tmp_path):
+    src = (
+        "import re\n"
+        "from volsync_tpu.obs import begin_span, span\n"
+        "from volsync_tpu import obs\n"
+        "with span('engine.read'):\n"
+        "    pass\n"
+        "with obs.span('svc.queue_wait', lanes=4):\n"  # attrs carry detail
+        "    pass\n"
+        "h = begin_span('repo.pack_upload', ctx=None)\n"
+        "h.finish('ok')\n"
+        "m = re.match('(a)', 'a')\n"
+        "s = m.span(1)\n"       # re.Match.span — not a tracing receiver
+    )
+    assert _lint_file(tmp_path, src) == []
+    # the tracing module defines span()/begin_span() and forwards
+    # caller-supplied names internally — exempt
+    dynamic = ("def span(name, **attrs):\n"
+               "    return name\n"
+               "x = 'dyn'\n"
+               "span(x)\n")
+    assert _lint_file(tmp_path, dynamic, name="tracing.py",
+                      subdir="obs") == []
+    assert _codes(_lint_file(tmp_path, dynamic)) == ["VL301"]
 
 
 def test_syntax_error_is_reported(tmp_path):

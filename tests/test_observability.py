@@ -112,3 +112,59 @@ def test_metrics_http_exposition(world, rng):
         ready = urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/readyz", timeout=5)
         assert ready.status == 200
+
+
+def test_probe_endpoints():
+    """The probe surface stands alone (no cluster needed): /healthz is
+    unconditional, /readyz flips 200 <-> 503 with ready_check, unknown
+    paths 404 — the contract a kubelet probe config relies on."""
+    import json
+    from urllib.error import HTTPError
+
+    ready = [True]
+    with MetricsServer(Metrics(), port=0,
+                       ready_check=lambda: ready[0]) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        health = urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert health.status == 200
+        assert health.read() == b"ok"
+        assert urllib.request.urlopen(
+            base + "/readyz", timeout=5).status == 200
+
+        ready[0] = False
+        with pytest.raises(HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/readyz", timeout=5)
+        assert exc_info.value.code == 503
+        assert exc_info.value.read() == b"not ready"
+        ready[0] = True
+        assert urllib.request.urlopen(
+            base + "/readyz", timeout=5).status == 200
+
+        with pytest.raises(HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/nope", timeout=5)
+        assert exc_info.value.code == 404
+
+        # /debug/trace serves the obs flight recorder as Chrome-trace
+        # JSON (the same document `volsync trace dump` writes).
+        from volsync_tpu.obs import (
+            reset_spans, reset_trace, span, trace_context)
+
+        reset_spans()
+        reset_trace()
+        try:
+            with trace_context(tenant="obs-test"), span("svc.stream"):
+                pass
+            resp = urllib.request.urlopen(base + "/debug/trace",
+                                          timeout=5)
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            doc = json.loads(resp.read().decode())
+            assert isinstance(doc["traceEvents"], list)
+            recorded = [e for e in doc["traceEvents"]
+                        if e.get("ph") == "X"]
+            assert any(e["name"] == "svc.stream" and
+                       e["args"].get("tenant") == "obs-test"
+                       for e in recorded)
+        finally:
+            reset_spans()
+            reset_trace()

@@ -26,7 +26,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="volsync lint",
         description="Repo-invariant AST lint for volsync-tpu "
-                    "(per-file rules VL001-VL005 and VL105, "
+                    "(per-file rules VL001-VL005, VL105 and VL301, "
                     "interprocedural rules VL101-VL104, shape/dtype "
                     "rules VL201-VL205; see docs/development.md)")
     parser.add_argument(

@@ -13,11 +13,16 @@ VL005  direct threading.Lock/RLock in data-plane modules (bypasses
 VL105  ad-hoc retry: time.sleep inside an except handler or a retry
        loop (a for/while containing a try) outside resilience.py —
        route through resilience.RetryPolicy
+VL301  span/trace names must be literal, dotted, lowercase strings at
+       the call site (no f-strings/concatenation/variables) — span
+       names become Prometheus label values, so dynamic names are
+       unbounded metric cardinality
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator, Optional
 
 from volsync_tpu.analysis.engine import FileContext, Finding, finding_at
@@ -411,6 +416,68 @@ class AdHocRetryRule:
         yield from findings
 
 
+class SpanNameLiteralRule:
+    """Span names feed Prometheus labels
+    (``volsync_stage_duration_seconds{stage}``,
+    ``volsync_svc_stage_seconds{stage}``) and the VL-clean flight
+    recorder: a dynamic name (f-string, concatenation, variable) at a
+    ``span()``/``begin_span()`` call site is unbounded label
+    cardinality. Names must be literal ``component.stage`` strings —
+    lowercase, dotted, ``[a-z0-9_]`` segments."""
+
+    code = "VL301"
+    name = "span-name-literal"
+    description = ("span()/begin_span() call whose name is not a literal "
+                   "dotted lowercase string")
+
+    TARGETS = ("span", "begin_span")
+    _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+    #: receiver names for attribute-style calls (obs.span(...),
+    #: tracing.begin_span(...)); a bare ``m.span(1)`` (re.Match.span)
+    #: is NOT matched because ``m`` is not a tracing receiver
+    RECEIVERS = ("obs", "tracing")
+
+    def _is_target(self, func: ast.AST) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in self.TARGETS
+        if isinstance(func, ast.Attribute) and func.attr in self.TARGETS:
+            return (isinstance(func.value, ast.Name)
+                    and func.value.id in self.RECEIVERS)
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # the tracing module itself defines span()/begin_span() and
+        # forwards caller-supplied names internally
+        if ctx.in_module("obs/tracing.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_target(node.func):
+                continue
+            name_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None)
+            if name_arg is None:
+                continue  # not the tracing API's shape
+            literal = _const_str(name_arg)
+            if literal is None:
+                yield finding_at(
+                    ctx.relpath, node, self.code,
+                    "span name is not a string literal — dynamic names "
+                    "(f-strings/concatenation/variables) are unbounded "
+                    "Prometheus label cardinality; use a literal "
+                    "component.stage name and carry variability in "
+                    "span attributes")
+            elif not self._NAME_RE.match(literal):
+                yield finding_at(
+                    ctx.relpath, node, self.code,
+                    f"span name {literal!r} is not dotted-lowercase "
+                    f"(expected e.g. 'engine.read': [a-z0-9_] segments "
+                    f"joined by '.')")
+
+
 def default_rules() -> list:
     return [EnvFlagRule(), ImportGateRule(), SilentExceptRule(),
-            TracerSafetyRule(), DirectLockRule(), AdHocRetryRule()]
+            TracerSafetyRule(), DirectLockRule(), AdHocRetryRule(),
+            SpanNameLiteralRule()]

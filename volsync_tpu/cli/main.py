@@ -90,13 +90,17 @@ def build_parser() -> argparse.ArgumentParser:
     m_del = migsub.add_parser("delete")
     m_del.add_argument("name")
 
-    # Registered for --help discoverability only; run() hands the verb
-    # (with all its options) straight to volsync_tpu.analysis.cli,
-    # which owns the real argument parsing.
+    # Registered for --help discoverability only; run() hands these
+    # verbs (with all their options) straight to volsync_tpu.analysis.cli
+    # / volsync_tpu.obs.cli, which own the real argument parsing.
     sub.add_parser(
         "lint", add_help=False,
         help="repo-invariant static analysis "
              "(python -m volsync_tpu.analysis)")
+    sub.add_parser(
+        "trace", add_help=False,
+        help="span flight recorder: dump Chrome-trace JSON / summary "
+             "(volsync_tpu.obs)")
 
     return parser
 
@@ -108,6 +112,10 @@ def run(argv, contexts: dict, out=print) -> int:
         from volsync_tpu.analysis.cli import main as lint_main
 
         return lint_main(list(argv[1:]), out=out)
+    if argv and argv[0] == "trace":
+        from volsync_tpu.obs.cli import main as trace_main
+
+        return trace_main(list(argv[1:]), out=out)
     args = build_parser().parse_args(argv)
     config_dir = Path(args.config_dir)
     try:
@@ -153,10 +161,12 @@ def run(argv, contexts: dict, out=print) -> int:
 def main(argv=None) -> int:
     """Demo-mode entry: boot a full in-process stack as the 'default'
     context (the operator's packaged entry point wires real state).
-    ``volsync lint`` never needs the runtime — dispatch it before the
-    boot so the linter runs in CI containers with no cluster state."""
+    ``volsync lint`` / ``volsync trace`` never need the runtime —
+    dispatch them before the boot so the linter runs in CI containers
+    with no cluster state and the flight recorder is readable from a
+    half-broken process."""
     argv = argv if argv is not None else sys.argv[1:]
-    if argv and argv[0] == "lint":
+    if argv and argv[0] in ("lint", "trace"):
         return run(argv, {})
     from volsync_tpu.operator import OperatorRuntime
 

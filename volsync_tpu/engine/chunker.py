@@ -596,11 +596,20 @@ class _ReadaheadStream:
         self._piece = piece_size
         self._eof = False
         self._gauge = _METRICS.pipeline_depth.labels(stage="read")
+        # the consumer's trace context, handed across the thread seam
+        # so engine.read spans attribute to the request being served
+        from volsync_tpu.obs import current_context
+        self._trace_ctx = current_context()
         self._thread = threading.Thread(
             target=self._produce, daemon=True, name="vtpk-readahead")
         self._thread.start()
 
     def _produce(self):
+        from volsync_tpu.obs import use_context
+        with use_context(self._trace_ctx):
+            self._produce_loop()
+
+    def _produce_loop(self):
         try:
             while not self._stop.is_set():
                 with span("engine.read"):

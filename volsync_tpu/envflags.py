@@ -255,6 +255,34 @@ def trace_dir() -> Optional[str]:
     return env_str("VOLSYNC_TRACE_DIR")
 
 
+def trace_sample() -> float:
+    """VOLSYNC_TRACE_SAMPLE: fraction of new root traces whose spans are
+    recorded into the flight recorder (1.0 = every trace, 0 = flight
+    recorder off; span totals + the stage histogram always record)."""
+    return env_float("VOLSYNC_TRACE_SAMPLE", 1.0, minimum=0.0)
+
+
+def trace_ring_size() -> int:
+    """VOLSYNC_TRACE_RING: span events retained in the in-process
+    flight-recorder ring buffer (oldest evicted first)."""
+    return env_int("VOLSYNC_TRACE_RING", 4096, minimum=16)
+
+
+def trace_dump_dir() -> Optional[str]:
+    """VOLSYNC_TRACE_DUMP: directory where trigger events (shed,
+    breaker-open, injected fault, deadline) auto-dump annotated
+    Chrome-trace JSON files; None (the default) disables auto-dumps
+    (the ring still records)."""
+    return env_str("VOLSYNC_TRACE_DUMP")
+
+
+def trace_trigger_interval() -> float:
+    """VOLSYNC_TRACE_TRIGGER_INTERVAL_S: minimum seconds between
+    auto-dumps for the SAME trigger reason, so a shed storm can't
+    fill the dump dir."""
+    return env_float("VOLSYNC_TRACE_TRIGGER_INTERVAL_S", 30.0, minimum=0.0)
+
+
 # -- native accelerator (io/native.py) -----------------------------------
 
 def no_native() -> bool:

@@ -141,6 +141,17 @@ class Metrics:
             "by tenant",
             ["tenant"], registry=self.registry,
         )
+        # Per-stream latency attribution (obs/tracing.py): seconds spent
+        # per pipeline stage, summed over spans that finished under a
+        # tenant-tagged TraceContext — where an admitted stream's time
+        # actually went (svc.admit / svc.queue_wait / svc.batch / ...).
+        # Stage values are lint-bounded literals (VL301), tenant values
+        # are registry-sanitized, so cardinality stays bounded.
+        self.svc_stage_seconds = Counter(
+            "volsync_svc_stage_seconds",
+            "Seconds spent per stage by tenant-attributed spans",
+            ["tenant", "stage"], registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":
@@ -172,7 +183,9 @@ class MetricsServer:
     """HTTP exposition + probes, the analogue of the reference manager's
     metrics listener on :8080 and healthz/readyz probes on :8081
     (controllers/metrics.go:82-85, main.go:140-153). One server carries
-    all three endpoints; ``port=0`` binds an ephemeral port (tests)."""
+    all the endpoints — /metrics, /healthz, /readyz, plus /debug/trace
+    serving the obs flight recorder as Chrome-trace JSON; ``port=0``
+    binds an ephemeral port (tests)."""
 
     def __init__(self, metrics: "Metrics", host: str = "127.0.0.1",
                  port: int = 8080,
@@ -194,6 +207,14 @@ class MetricsServer:
                     ok = outer.ready_check is None or outer.ready_check()
                     body = b"ok" if ok else b"not ready"
                     ctype, code = "text/plain", (200 if ok else 503)
+                elif self.path == "/debug/trace":
+                    # Imported lazily: obs depends on this module, so a
+                    # top-level import here would be a cycle.
+                    import json
+
+                    from volsync_tpu import obs
+                    body = json.dumps(obs.chrome_trace()).encode("utf-8")
+                    ctype, code = "application/json", 200
                 else:
                     body, ctype, code = b"not found", "text/plain", 404
                 self.send_response(code)

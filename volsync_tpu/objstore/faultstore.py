@@ -50,6 +50,7 @@ from typing import Iterator, Optional
 
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
+from volsync_tpu.obs import record_trigger
 from volsync_tpu.resilience import ThrottleError, TransientError
 
 
@@ -207,6 +208,11 @@ class FaultStore:
         real operation; ``torn_execute()`` (writes only) performs the
         truncated form for partial_put."""
         fired = self._decide(op, key)
+        if fired:
+            # flight-recorder annotation, outside self._lock (_decide
+            # released it) so the dump can never nest under it
+            record_trigger("fault", op=op, key=key,
+                           kinds=[s.kind for s in fired])
         for spec in fired:
             if spec.kind == "latency" and spec.latency > 0:
                 self._sleep(spec.latency)

@@ -17,6 +17,7 @@ from concurrent.futures import Future
 
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
+from volsync_tpu.obs import span
 from volsync_tpu.ops.gearcdc import GearParams
 
 
@@ -138,8 +139,13 @@ class SegmentMicroBatcher:
         while True:
             batch = self._dq.get()
             try:
-                results = self._hasher.hash_segments(
-                    [(d, n, e) for d, n, e, _ in batch])
+                # One span per coalesced device dispatch. A batch mixes
+                # segments from many streams/traces, so this span is
+                # context-free; per-stream attribution happens in the
+                # scheduler's svc.batch span around each future.
+                with span("ops.batch_dispatch", lanes=len(batch)):
+                    results = self._hasher.hash_segments(
+                        [(d, n, e) for d, n, e, _ in batch])
                 for (_, _, _, f), r in zip(batch, results):
                     f.set_result(r)
             except Exception as exc:  # noqa: BLE001 — per-caller delivery

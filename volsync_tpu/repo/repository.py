@@ -34,7 +34,7 @@ from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
 from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
-from volsync_tpu.obs import span
+from volsync_tpu.obs import carry_context, span
 from volsync_tpu.repo import blobid, crypto
 from volsync_tpu.repo.shardedindex import ShardedBlobIndex
 from volsync_tpu.repo.compress import Compressor, Decompressor
@@ -609,7 +609,10 @@ class Repository:
         lockcheck.assert_held(self._lock, "repo write path (add blob)")
         if self.pipelined:
             self._pl_raise()
-            fut = _get_seal_pool().submit(self._encode_blob, data)
+            # carry_context: seal-stage spans keep the submitting
+            # request's trace across the pool-thread seam
+            fut = _get_seal_pool().submit(
+                carry_context(self._encode_blob), data)
             self._pl_open.append(_OpenBlob(
                 meta={"id": blob_id, "type": btype,
                       "raw_length": len(data)},
@@ -699,8 +702,8 @@ class Repository:
         self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
         self._pl_upload_slots.acquire()
         try:
-            fut = _get_upload_pool().submit(self._upload_pack, body,
-                                            entries)
+            fut = _get_upload_pool().submit(
+                carry_context(self._upload_pack), body, entries)
         except BaseException:
             # on the success path _upload_pack's finally releases the
             # slot; if the submit itself fails, no worker ever runs,

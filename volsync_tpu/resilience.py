@@ -50,7 +50,7 @@ from typing import Callable, Iterator, Optional
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
-from volsync_tpu.obs import span
+from volsync_tpu.obs import record_trigger, span
 
 log = logging.getLogger("volsync_tpu.resilience")
 
@@ -247,6 +247,8 @@ class RetryPolicy:
                 elapsed = time.monotonic() - t0
                 if (self.deadline is not None
                         and elapsed + delay > self.deadline):
+                    record_trigger("deadline", site=self.site,
+                                   attempt=attempt, elapsed_s=round(elapsed, 4))
                     raise DeadlineExceeded(self.site, elapsed, exc) from exc
                 log.debug("%s: attempt %d/%d failed (%s); backing off "
                           "%.3fs", self.site, attempt, self.max_attempts,
@@ -326,6 +328,10 @@ class CircuitBreaker:
         self._gauge.set(_STATE_CODE[state])
         GLOBAL_METRICS.breaker_transitions.labels(
             backend=self.backend, to=state).inc()
+        if state == "open":
+            # flight-recorder annotation; obs takes only its own lock,
+            # never this breaker's, so nesting under self._lock is safe
+            record_trigger("breaker_open", backend=self.backend)
         log.info("breaker %s -> %s", self.backend, state)
 
     def before_call(self):

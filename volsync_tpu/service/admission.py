@@ -39,7 +39,7 @@ from typing import Callable, Mapping, Optional
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
-from volsync_tpu.obs import span
+from volsync_tpu.obs import record_trigger, span
 from volsync_tpu.service.tenants import TenantRegistry
 
 
@@ -64,6 +64,9 @@ class StreamTicket:
     #: high-water mark of request bytes the handler buffered beyond the
     #: segment in flight — observability for the credit-based pause
     buffered_high_water: int = 0
+    #: TraceContext of the stream span — the handler threads it through
+    #: the scheduler so device-batch spans attribute to this stream
+    trace: object = None
     _released: bool = field(default=False, repr=False)
 
 
@@ -137,6 +140,9 @@ class AdmissionController:
     def _shed(self, tenant: str, reason: str,
               retry_after: Optional[float] = None) -> AdmissionRejected:
         self._shed_counter(tenant, reason).inc()
+        # Flight-recorder annotation: what the service was doing right
+        # before it started refusing work (auto-dumps when armed).
+        record_trigger("shed", tenant=tenant, cause=reason)
         return AdmissionRejected(
             tenant, reason,
             self.retry_after if retry_after is None else retry_after)

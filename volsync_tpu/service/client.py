@@ -21,12 +21,14 @@ from typing import Callable, Iterator, Optional
 
 import grpc
 
+from volsync_tpu.obs import begin_span, format_trace_header, new_id, new_trace
 from volsync_tpu.resilience import RetryPolicy, ThrottleError
 from volsync_tpu.service import moverjax_pb2 as pb
 from volsync_tpu.service.server import (
     RETRY_AFTER_METADATA_KEY,
     SERVICE_NAME,
     TOKEN_METADATA_KEY,
+    TRACE_METADATA_KEY,
 )
 from volsync_tpu.service.tenants import TENANT_METADATA_KEY
 
@@ -111,7 +113,18 @@ class MoverJaxClient:
     def chunk_stream(self, reader: Callable[[int], bytes],
                      ) -> Iterator[tuple[int, int, str]]:
         """Stream ``reader`` to the service -> (offset, length, digest)
-        per finalized chunk, in order, covering the whole stream."""
+        per finalized chunk, in order, covering the whole stream.
+
+        Each call is the root of a fresh trace (tenant + generated
+        stream id) whose context rides ``x-volsync-trace`` metadata, so
+        the server's svc.* spans join this client span in one
+        flight-recorder trace. The span is handle-based, not a
+        contextvar held across ``yield`` — a generator's context would
+        leak into the consuming thread between iterations."""
+        tctx = new_trace(tenant=self.tenant, stream_id=new_id())
+        handle = begin_span("client.chunk_stream", ctx=tctx)
+        meta = self._meta + ((TRACE_METADATA_KEY,
+                              format_trace_header(tctx.child(handle.span_id))),)
 
         def segments():
             while True:
@@ -121,17 +134,21 @@ class MoverJaxClient:
                     return
                 yield pb.DataSegment(data=piece)
 
-        call = self._chunk_hash(segments(), metadata=self._meta,
+        call = self._chunk_hash(segments(), metadata=meta,
                                 timeout=self._timeout)
+        ok = False
         try:
             for batch in call:
                 for c in batch.chunks:
                     yield int(c.offset), int(c.length), c.digest
+            ok = True
         except grpc.RpcError as err:
             shed = shed_from_rpc(err)
             if shed is not None:
                 raise shed from err
             raise
+        finally:
+            handle.finish("ok" if ok else "error")
 
     def chunk_bytes(self, data: bytes) -> list[tuple[int, int, str]]:
         view = memoryview(data)

@@ -42,7 +42,7 @@ from concurrent.futures import Future
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
-from volsync_tpu.obs import span
+from volsync_tpu.obs import begin_span, span, use_context
 from volsync_tpu.service.tenants import TenantRegistry
 
 
@@ -60,6 +60,11 @@ class _Item:
     tenant: str
     enqueued_at: float
     cost: int  # bytes (>= 1 so empty eof flushes still cost a unit)
+    #: the submitting stream's TraceContext, carried across the
+    #: collector-thread seam so dispatch/batch spans attribute to it
+    ctx: object = None
+    #: open svc.queue_wait span handle, finished at dispatch
+    qspan: object = None
 
 
 @dataclass
@@ -137,10 +142,13 @@ class SegmentScheduler:
             return st
 
     def submit(self, tenant: str, data: bytes, length: int,
-               eof: bool) -> Future:
+               eof: bool, ctx=None) -> Future:
         """Enqueue one segment; the future resolves with the batcher's
         (chunks, consumed). Blocks — the credit-based pause — while the
-        tenant's queue is at its bound."""
+        tenant's queue is at its bound. ``ctx`` is the submitting
+        stream's TraceContext (or None): queue-wait and device-batch
+        spans attribute to it even though they finish on the collector
+        and batcher threads."""
         st = self._state_for(tenant)
         while not st.credits.acquire(timeout=0.1):
             if self._stopped.is_set():
@@ -150,7 +158,8 @@ class SegmentScheduler:
             raise SchedulerStopped("scheduler stopped")
         item = _Item(data=data, length=length, eof=eof, future=Future(),
                      tenant=tenant, enqueued_at=self._clock(),
-                     cost=max(1, length))
+                     cost=max(1, length), ctx=ctx,
+                     qspan=begin_span("svc.queue_wait", ctx=ctx))
         with self._lock:
             st.q.append(item)
             self._queued += 1
@@ -208,18 +217,25 @@ class SegmentScheduler:
         # by stop (stranded items are failed, never lost)
         while not self._slots.acquire(timeout=0.1):
             if self._stopped.is_set():
+                if item.qspan is not None:
+                    item.qspan.finish("error")
                 if not item.future.done():
                     item.future.set_exception(
                         SchedulerStopped("scheduler stopped"))
                 return
+        if item.qspan is not None:
+            item.qspan.finish("ok")
         st.latency_gauge.set(self._clock() - item.enqueued_at)
         with self._lock:
             self._dispatched += 1
+        bspan = begin_span("svc.batch", ctx=item.ctx)
         try:
-            with span("svc.schedule"):
-                inner = self._batcher.submit_async(
-                    item.data, item.length, item.eof)
+            with use_context(item.ctx):
+                with span("svc.schedule"):
+                    inner = self._batcher.submit_async(
+                        item.data, item.length, item.eof)
         except BaseException as exc:
+            bspan.finish("error")
             self._slots.release()
             if not item.future.done():
                 item.future.set_exception(exc)
@@ -227,9 +243,10 @@ class SegmentScheduler:
 
         def _chain(done: Future, out: Future = item.future) -> None:
             self._slots.release()
+            exc = done.exception()
+            bspan.finish("ok" if exc is None else "error")
             if out.done():
                 return
-            exc = done.exception()
             if exc is not None:
                 out.set_exception(exc)
             else:
@@ -270,6 +287,8 @@ class SegmentScheduler:
             st = self._states[item.tenant]
             st.credits.release()
             st.depth_gauge.set(0)
+            if item.qspan is not None:
+                item.qspan.finish("error")
             if not item.future.done():
                 item.future.set_exception(
                     SchedulerStopped("scheduler stopped"))

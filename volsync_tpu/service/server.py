@@ -52,6 +52,8 @@ import grpc
 import numpy as np
 
 from volsync_tpu import envflags
+from volsync_tpu.obs import begin_span, new_trace, parse_trace_header, \
+    use_context
 from volsync_tpu.ops.batcher import BatcherStopped, SegmentMicroBatcher
 from volsync_tpu.service import moverjax_pb2 as pb
 from volsync_tpu.service.admission import (
@@ -67,6 +69,9 @@ SERVICE_NAME = "moverjax.MoverJax"
 TOKEN_METADATA_KEY = "x-volsync-token"
 #: trailing-metadata key carrying the shed retry-after hint (ms)
 RETRY_AFTER_METADATA_KEY = "x-volsync-retry-after-ms"
+#: request-metadata key carrying the client's trace context
+#: (obs.format_trace_header) so client + server spans join one trace
+TRACE_METADATA_KEY = "x-volsync-trace"
 
 #: Stream segmentation mirrors engine/chunker.stream_chunks: a segment is
 #: processed once at least this much beyond max_size is buffered.
@@ -256,12 +261,32 @@ class MoverJaxServer:
         """Admission-gated streaming CDC: tenant resolution + admission
         BEFORE the first byte is read, then the carry-the-tail protocol
         of engine/chunker.stream_chunks — a remote stream chunks
-        bit-identically to a local scan of the same bytes."""
+        bit-identically to a local scan of the same bytes.
+
+        Tracing: the client's ``x-volsync-trace`` header (or a fresh
+        root when absent/malformed) becomes this stream's TraceContext;
+        the whole handler is one ``svc.stream`` span, admission and the
+        scheduler/device spans nest under it, and the ticket carries
+        the context across the scheduler thread seam. Spans are
+        recorded via an explicit handle, not a contextvar held across
+        ``yield`` — a generator's context leaks into whichever thread
+        consumes it."""
         meta = dict(context.invocation_metadata())
         tenant = self._admission.tenant_from(meta)
+        tctx = parse_trace_header(meta.get(TRACE_METADATA_KEY))
+        if tctx is not None:
+            # the tenant claim is resolved server-side (token-scoped);
+            # never trust one riding the trace header
+            tctx = tctx.evolve(tenant=tenant)
+        else:
+            tctx = new_trace(tenant=tenant)
+        handle = begin_span("svc.stream", ctx=tctx)
+        stream_ctx = tctx.child(handle.span_id)
         try:
-            ticket = self._admission.admit_stream(tenant)
+            with use_context(stream_ctx):
+                ticket = self._admission.admit_stream(tenant)
         except AdmissionRejected as rej:
+            handle.finish("error")
             context.set_trailing_metadata((
                 (RETRY_AFTER_METADATA_KEY,
                  str(max(1, int(rej.retry_after * 1000)))),))
@@ -269,11 +294,18 @@ class MoverJaxServer:
                     else grpc.StatusCode.RESOURCE_EXHAUSTED)
             context.abort(code, str(rej))
             return  # pragma: no cover — abort raises
+        ticket.trace = stream_ctx
         try:
             yield from self._serve_stream(request_iterator, ticket)
         except (SchedulerStopped, BatcherStopped):
+            handle.finish("error")
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           "server shutting down")
+        except BaseException:
+            handle.finish("error")
+            raise
+        else:
+            handle.finish("ok")
         finally:
             self._admission.release(ticket)
 
@@ -283,16 +315,21 @@ class MoverJaxServer:
         (chunks, consumed_hint)."""
         if self._scheduler is not None:
             return self._scheduler.submit(ticket.tenant, data,
-                                          len(data), eof)
+                                          len(data), eof,
+                                          ctx=ticket.trace)
         f: Future = Future()
+        handle = begin_span("svc.batch", ctx=ticket.trace)
         try:
             if self._batcher is not None:
                 f.set_result(self._batcher.submit(data, len(data), eof))
             else:
-                out = self._hasher.process(
-                    np.frombuffer(data, np.uint8), eof=eof)
+                with use_context(ticket.trace):
+                    out = self._hasher.process(
+                        np.frombuffer(data, np.uint8), eof=eof)
                 f.set_result((out, 0))
+            handle.finish("ok")
         except BaseException as exc:
+            handle.finish("error")
             f.set_exception(exc)
         return f
 
