@@ -3,7 +3,7 @@
 # tier-1 command in ROADMAP.md.
 
 .PHONY: lint test chaos static-check bench-index-smoke \
-	service-bench-smoke trace-smoke clean-lint
+	service-bench-smoke trace-smoke session-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105 + VL301 per-file + VL101-VL104
@@ -49,6 +49,14 @@ service-bench-smoke:
 # edges, thread names, trigger annotation).
 trace-smoke:
 	JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
+# Supervised-session soak (docs/sessions.md): seeded FakeSessionBackend
+# chaos — probe hang, keepalive drop, zombie-holds-device — must recycle
+# within the hard TTL, complete a job on the fresh session, fence the
+# zombie's stale write, and reproduce the identical transition trace on
+# a second run of the same seed. No chip required.
+session-smoke:
+	JAX_PLATFORMS=cpu python scripts/session_smoke.py
 
 clean-lint:
 	rm -f lint.sarif .lint-cache

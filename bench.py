@@ -58,7 +58,15 @@ import time
 import numpy as np
 
 # envflags imports only os — safe before the JAX env setup below.
-from volsync_tpu.envflags import env_bool, env_int, env_str, no_pallas
+from volsync_tpu.envflags import (
+    env_bool,
+    env_int,
+    env_str,
+    no_pallas,
+    session_backend,
+    session_epoch,
+    session_id,
+)
 
 # Persistent compilation cache: retries and later rounds reuse compiled
 # executables instead of paying the 20-40s first compile again. Must be
@@ -96,6 +104,14 @@ _BEST_LOCK = threading.Lock()
 
 
 def _emit(result: dict) -> None:
+    """Print one result line — REFUSED unless it carries a provenance
+    block. An unattributable number is worse than no number: round 4's
+    CPU-fallback figures were only caught because provenance said so
+    (docs/performance.md). Callers stamp ``bench_provenance()`` first."""
+    if not result.get("provenance"):
+        raise ValueError(
+            "bench result refused: no provenance block "
+            f"(keys: {sorted(result)})")
     print(json.dumps(result), flush=True)
 
 
@@ -146,6 +162,13 @@ def bench_provenance(extra: Optional[dict] = None) -> dict:
     prov["volsync_flags"] = {
         k: v for k, v in sorted(dict(os.environ).items())
         if k.startswith("VOLSYNC_") or k == "JAX_PLATFORMS"}
+    sid = session_id()
+    if sid:
+        # Stamped by the serialized bench queue (cluster/sessions.py)
+        # into every job's environment: which supervised session, under
+        # which fencing epoch, produced this number.
+        prov["session"] = {"id": sid, "epoch": session_epoch(),
+                           "backend": session_backend() or "unknown"}
     if extra:
         prov.update(extra)
     return prov
@@ -157,8 +180,13 @@ def _watchdog() -> None:
         best = _BEST
     if best is not None:
         _log("bench: WATCHDOG fired after measurement — emitting best result")
-        _emit(best)
-        os._exit(0)
+        try:
+            _emit(best)
+            os._exit(0)
+        except ValueError as e:
+            # Provenance refusal must not strand the watchdog short of
+            # its os._exit — fall through to the no-result exit code.
+            _log(f"bench: WATCHDOG result refused: {e}")
     _log(f"bench: WATCHDOG fired with no result after {GLOBAL_BUDGET_S}s")
     os._exit(75)
 
@@ -242,32 +270,15 @@ def _kill_stale_bench_children(
     second bench would itself be a single-tenant violation) and that
     are not this process or its parent. Never touches other TPU
     clients. ``marker`` is parameterized so tests can sweep a sentinel
-    value without ever matching a real run."""
-    import glob
+    value without ever matching a real run.
 
-    killed = 0
-    own = {os.getpid(), os.getppid()}
-    want = marker.encode()
-    for path in glob.glob("/proc/[0-9]*/environ"):
-        try:
-            pid = int(path.split("/")[2])
-        except ValueError:
-            continue
-        if pid in own:
-            continue
-        try:
-            with open(path, "rb") as f:
-                env_blob = f.read()
-        except OSError:
-            continue
-        if want in env_blob.split(b"\0"):
-            try:
-                os.kill(pid, signal.SIGKILL)
-                killed += 1
-                _log(f"bench: recovery killed stale measurement pid {pid}")
-            except OSError:
-                pass
-    return killed
+    The /proc sweep itself lives in cluster/sessions.py now (it is the
+    session supervisor's ``force_release`` action); this wrapper keeps
+    the historical bench entry point. Imported lazily so the bench can
+    still start if the cluster package is mid-refactor."""
+    from volsync_tpu.cluster.sessions import kill_marked_children
+
+    return kill_marked_children(marker, log_fn=_log)
 
 
 def _recover_backend() -> Optional[str]:

@@ -1,23 +1,26 @@
 """Builder-run chip measurement -> provenance-stamped BENCH_SELF artifact.
 
 Runs the SHIPPED bench measurement (bench.py's inner path — identical
-code to what the driver runs) over a ladder of configs, one rung at a
-time on the single-tenant tunnel, and writes BENCH_SELF_r{N}.json with
-full provenance: verbatim commands, environment knobs, git commit,
-library versions, per-rung results, and the best number. The artifact
-is self-attested (the judge can re-run every command verbatim); its
-purpose is measure-early-measure-often — land a live number after each
-optimization instead of hoping the round-end driver run catches one.
+code to what the driver runs) over a ladder of configs through the
+supervised session queue (volsync_tpu/cluster/sessions.py): every rung
+is admitted as the next serialized verify-then-measure job — a live
+probe in front, a hard deadline behind, auto-recycle on wedge — so a
+leaked session from one rung can never silently poison the next
+(docs/performance.md, rounds 4/5). The artifact BENCH_SELF_r{N}.json
+carries full provenance: verbatim commands, environment knobs, git
+commit, library versions, per-rung results WITH the session identity
+(backend, session id, fencing epoch) each number was produced under,
+and the best number. It is self-attested (the judge can re-run every
+command verbatim).
 
 Usage:
     python scripts/bench_self.py r05 [CFG ...]
         CFG like B:64,8,6 or S:32,4,4; optional KEY=VAL env prefixes,
         e.g. VOLSYNC_PAGEMAJOR=1:B:64,8,6 A/Bs the page-major layout.
 
-Each rung gets an inner budget (default 1100s) and a hard timeout —
-never SIGTERM a TPU client mid-run by hand; rungs that exceed the
-budget are killed by their own harness with the session consequences
-documented in docs/performance.md.
+Each rung gets an inner budget (default 1100s); the session queue
+kills a rung at its hard deadline and recycles the session — never
+SIGTERM a TPU client mid-run by hand.
 """
 
 from __future__ import annotations
@@ -32,7 +35,9 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
+from volsync_tpu.cluster import sessions  # noqa: E402
 from volsync_tpu.envflags import env_int  # noqa: E402
+
 DEFAULT_RUNGS = [
     "B:64,8,6",                       # primary batched shape (r4 rung 1)
     "B:128,8,3",                      # 2x bytes per dispatch (segment)
@@ -49,19 +54,7 @@ RUNG_BUDGET_S = env_int("VOLSYNC_SELF_RUNG_BUDGET", 1100)
 AB_KNOBS = ("VOLSYNC_BENCH_PIPELINES", "VOLSYNC_PAGEMAJOR")
 
 
-def _run(cmd: list[str], env: dict, timeout: int) -> tuple[int, str, str]:
-    try:
-        r = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                           timeout=timeout)
-        return r.returncode, r.stdout, r.stderr
-    except subprocess.TimeoutExpired as e:
-        out = e.stdout or ""
-        if isinstance(out, bytes):  # TimeoutExpired ignores text=True
-            out = out.decode(errors="replace")
-        return 124, out, "TIMEOUT"
-
-
-def _provenance() -> dict:
+def _provenance(supervisor: sessions.SessionSupervisor) -> dict:
     def sh(*args):
         try:
             return subprocess.run(args, capture_output=True, text=True,
@@ -81,14 +74,18 @@ def _provenance() -> dict:
         "python": sys.version.split()[0],
         "hostname": sh("hostname"),
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "session": supervisor.provenance(),
         "methodology": (
             "Shipped bench.py inner measurement per rung (identical "
-            "code to the driver's run): device-resident salted inputs "
-            "(the serving tunnel memoizes identical executions), "
-            "on-TPU golden check against a pure-host numpy+hashlib "
-            "reference before timing, result fetched per dispatch "
-            "(the shipped protocol's one small fetch). CPU baseline: "
-            "numpy gear scan + hashlib SHA-256 on one core."),
+            "code to the driver's run), each rung serialized through "
+            "the supervised session queue: verify probe before, hard "
+            "deadline + auto-recycle behind, fencing-epoch check on "
+            "the result. Device-resident salted inputs (the serving "
+            "tunnel memoizes identical executions), on-TPU golden "
+            "check against a pure-host numpy+hashlib reference before "
+            "timing, result fetched per dispatch (the shipped "
+            "protocol's one small fetch). CPU baseline: numpy gear "
+            "scan + hashlib SHA-256 on one core."),
     }
 
 
@@ -110,54 +107,79 @@ def main() -> int:
     tag = sys.argv[1]  # e.g. r05
     rungs = sys.argv[2:] or DEFAULT_RUNGS
     out_path = ROOT / f"BENCH_SELF_{tag}.json"
+
+    for knob in AB_KNOBS:
+        os.environ.pop(knob, None)
+    supervisor = sessions.SessionSupervisor(sessions.JaxSessionBackend())
+    queue = sessions.BenchQueue(supervisor,
+                                job_deadline=RUNG_BUDGET_S + 60)
+
     results = []
     best = None
-    for spec in rungs:
-        extra_env, config = _parse_rung(spec)
-        base = {k: v for k, v in os.environ.items() if k not in AB_KNOBS}
-        env = dict(base, VOLSYNC_BENCH_INNER="1",
-                   VOLSYNC_BENCH_CONFIG=config,
-                   VOLSYNC_BENCH_BUDGET_S=str(RUNG_BUDGET_S),
-                   VOLSYNC_BENCH_CONFIG_DEADLINE=str(RUNG_BUDGET_S - 200),
-                   **extra_env)
-        cmd = [sys.executable, str(ROOT / "bench.py")]
-        shown = " ".join(
-            [f"VOLSYNC_BENCH_INNER=1 VOLSYNC_BENCH_CONFIG={config}",
-             f"VOLSYNC_BENCH_BUDGET_S={RUNG_BUDGET_S}",
-             *[f"{k}={v}" for k, v in extra_env.items()],
-             "python", "bench.py"])
-        print(f"== rung {spec}", flush=True)
-        t0 = time.time()
-        rc, out, err = _run(cmd, env, RUNG_BUDGET_S + 60)
-        dt = round(time.time() - t0, 1)
-        parsed = None
-        for line in reversed(out.strip().splitlines()):
+    with supervisor:  # keepalive between rungs (paused during each)
+        for spec in rungs:
+            extra_env, config = _parse_rung(spec)
+            env = dict(VOLSYNC_BENCH_INNER="1",
+                       VOLSYNC_BENCH_CONFIG=config,
+                       VOLSYNC_BENCH_BUDGET_S=str(RUNG_BUDGET_S),
+                       VOLSYNC_BENCH_CONFIG_DEADLINE=str(
+                           RUNG_BUDGET_S - 200),
+                       **extra_env)
+            cmd = [sys.executable, str(ROOT / "bench.py")]
+            shown = " ".join(
+                [f"VOLSYNC_BENCH_INNER=1 VOLSYNC_BENCH_CONFIG={config}",
+                 f"VOLSYNC_BENCH_BUDGET_S={RUNG_BUDGET_S}",
+                 *[f"{k}={v}" for k, v in extra_env.items()],
+                 "python", "bench.py"])
+            print(f"== rung {spec}", flush=True)
+            t0 = time.time()
             try:
-                parsed = json.loads(line)
-                break
-            except ValueError:
+                job = queue.run_command(cmd, label="bench-rung",
+                                        env_extra=env)
+            except sessions.SessionError as exc:
+                # verify never passed / deadline kill / fenced result —
+                # the session was already recycled; record and move on
+                dt = round(time.time() - t0, 1)
+                entry = {"rung": spec, "command": shown, "rc": 75,
+                         "wall_s": dt, "result": None,
+                         "session_error": str(exc)}
+                results.append(entry)
+                print(f"   SESSION ERROR after {dt}s: {exc}", flush=True)
                 continue
-        entry = {"rung": spec, "command": shown, "rc": rc,
-                 "wall_s": dt, "result": parsed}
-        if rc != 0 or parsed is None:
-            entry["stderr_tail"] = err.strip()[-500:]
-        results.append(entry)
-        print(f"   rc={rc} wall={dt}s result={parsed}", flush=True)
-        if parsed and parsed.get("backend") not in (None, "cpu",
-                                                    "cpu-fallback"):
-            if best is None or parsed["value"] > best["value"]:
-                best = dict(parsed, rung=spec)
-        # One rung at a time with a settle gap: the tunnel is
-        # single-tenant and back-to-back sessions can collide. Pacing,
-        # not an error retry — RetryPolicy doesn't apply.
-        time.sleep(10)  # lint: ignore[VL105]
-    artifact = {
-        "artifact": f"BENCH_SELF_{tag}",
-        "self_attested": True,
-        "provenance": _provenance(),
-        "rungs": results,
-        "best": best,
-    }
+            dt = round(time.time() - t0, 1)
+            rc, out = job["result"]["rc"], job["result"]["stdout"]
+            parsed = None
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+            entry = {"rung": spec, "command": shown, "rc": rc,
+                     "wall_s": dt, "result": parsed,
+                     "session": job["session"]}
+            if rc != 0 or parsed is None:
+                entry["stderr_tail"] = (
+                    job["result"]["stderr"].strip()[-500:])
+            results.append(entry)
+            print(f"   rc={rc} wall={dt}s result={parsed}", flush=True)
+            if parsed and parsed.get("backend") not in (None, "cpu",
+                                                        "cpu-fallback"):
+                if best is None or parsed["value"] > best["value"]:
+                    best = dict(parsed, rung=spec)
+        artifact = {
+            "artifact": f"BENCH_SELF_{tag}",
+            "self_attested": True,
+            "provenance": _provenance(supervisor),
+            "rungs": results,
+            "best": best,
+        }
+    if not artifact.get("provenance"):
+        # Same contract as bench._emit: an unattributable artifact
+        # must never be written.
+        print("bench_self: artifact refused — no provenance block",
+              file=sys.stderr)
+        return 75
     out_path.write_text(json.dumps(artifact, indent=2) + "\n")
     print(f"wrote {out_path}" + (f" best={best['value']} GiB/s "
                                  f"({best['rung']})" if best else

@@ -1,0 +1,103 @@
+"""Session-supervisor smoke: seeded FakeSessionBackend soak, no chip.
+
+Drives the full wedge -> recycle -> measure story deterministically
+(`make session-smoke`, wired into scripts/static_check.sh): a seeded
+fault schedule hangs the verify probe, drops keepalives, and turns one
+session zombie; the supervisor must recycle within the hard TTL, the
+queue must complete a job on the fresh session, the zombie's stale-
+epoch write must be refused, and a second identical run must produce
+the IDENTICAL transition trace. Exit 0 only if every invariant holds.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from volsync_tpu.cluster.sessions import (  # noqa: E402
+    BenchQueue,
+    FakeClock,
+    FakeSessionBackend,
+    FencedError,
+    SessionSupervisor,
+)
+from volsync_tpu.objstore.faultstore import FaultSchedule, FaultSpec  # noqa: E402
+
+SEED = 7
+TTL = 900.0
+
+SPECS = [
+    FaultSpec(kind="hang", at=2, op="probe", latency=400.0),
+    FaultSpec(kind="transient", at=2, op="keepalive"),
+    FaultSpec(kind="zombie", at=4, op="keepalive"),
+]
+
+
+def soak(seed: int) -> tuple[list, FakeSessionBackend]:
+    clock = FakeClock()
+    backend = FakeSessionBackend(FaultSchedule(seed=seed, specs=SPECS),
+                                 clock=clock)
+    sup = SessionSupervisor(backend, ttl=TTL, keepalive_interval=30,
+                            probe_timeout=300, max_keepalive_failures=2,
+                            clock=clock, sleep_fn=clock.sleep,
+                            status_path="")
+    queue = BenchQueue(sup, job_deadline=120, clock=clock)
+
+    done = []
+    # job 1: clean path
+    done.append(queue.run(lambda: "m1", label="first"))
+    # keepalive drop (spec 2) degrades, next beat recovers
+    for _ in range(3):
+        sup.tick()
+        clock.sleep(30)
+    assert sup.state == "healthy", sup.state
+    # job 2: verify probe hangs 400s (> 300s budget) -> recycle ->
+    # fresh session measured
+    t_wedge = clock()
+    done.append(queue.run(lambda: "m2", label="second"))
+    recycle_lag = clock() - t_wedge
+    assert recycle_lag <= TTL, f"recycle took {recycle_lag}s > TTL"
+    # zombie: session stops answering but holds the device; ticks must
+    # cross DEGRADED into a forced recycle that frees the slot
+    for _ in range(4):
+        sup.tick()
+        clock.sleep(30)
+    # job 3 lands on the post-zombie session
+    done.append(queue.run(lambda: "m3", label="third"))
+    # the zombie's stale epoch is fenced out
+    stale = done[1]["session"]["epoch"]
+    try:
+        sup.guard(stale)
+        raise AssertionError("stale epoch was NOT fenced")
+    except FencedError:
+        pass
+    assert backend.max_concurrent_jobs == 1, backend.max_concurrent_jobs
+    assert backend.force_releases >= 2, backend.force_releases
+    epochs = [d["session"]["epoch"] for d in done]
+    assert epochs == sorted(set(epochs)), f"epoch reuse: {epochs}"
+    return sup.transitions, backend
+
+
+def main() -> int:
+    trace_a, backend = soak(SEED)
+    trace_b, _ = soak(SEED)
+    if trace_a != trace_b:
+        print("session-smoke: FAIL — same seed, different transition "
+              f"traces:\n  {trace_a}\n  {trace_b}")
+        return 1
+    causes = [c for (_, _, c) in trace_a]
+    for needed in ("probe_timeout", "keepalive_failures"):
+        if needed not in causes:
+            print(f"session-smoke: FAIL — no {needed} recycle in "
+                  f"{causes}")
+            return 1
+    print(f"session-smoke: ok — {len(trace_a)} transitions, "
+          f"{backend.force_releases} force-releases, causes={causes}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,6 +23,10 @@
 #   5. The flight-recorder smoke (`make trace-smoke`): a tiny pipeline
 #      run must export a Perfetto-loadable Chrome-trace-event dump
 #      (docs/observability.md).
+#   6. The supervised-session smoke (`make session-smoke`): seeded
+#      FakeSessionBackend chaos — wedge -> recycle -> job completes,
+#      zombie write fenced, deterministic transition trace
+#      (docs/sessions.md).
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -45,5 +49,8 @@ make --no-print-directory service-bench-smoke > /dev/null
 
 echo "== trace-smoke =="
 make --no-print-directory trace-smoke
+
+echo "== session-smoke =="
+make --no-print-directory session-smoke
 
 echo "static_check: OK"

@@ -1,12 +1,14 @@
 #!/usr/bin/env bash
-# Tunnel watcher: probe the single-tenant serving tunnel ONCE AN HOUR
-# (hammering a wedged tunnel with killed probes extends the outage —
-# docs/performance.md), and the moment a probe succeeds, run the full
-# measurement sequence serially and commit the artifacts:
-#
-#   1. scripts/bench_self.py r05      (provenance-stamped kernel rungs)
-#   2. scripts/service_bench.py       (N gRPC streams, coalesced)
-#   3. bench_scale.py fleet           (BASELINE configs[5] on hardware)
+# Tunnel watcher — thin wrapper over the supervised-session CLI
+# (volsync_tpu/cluster/sessioncli.py). The probe/recovery logic that
+# used to live here (and in the retired chip_recovery_playbook.sh) is
+# now `volsync session`: status --probe does the hourly live check,
+# recycle force-releases stale measurement children, and run admits
+# each measurement as the next serialized verify-then-measure job with
+# a hard deadline and auto-recycle. This script only owns pacing
+# (probe ONCE AN HOUR: hammering a wedged tunnel with killed probes
+# extends the outage — docs/performance.md), deadline arithmetic, and
+# the artifact commit.
 #
 # Hard-stops at the deadline (epoch seconds, $1) so it can never
 # collide with the driver's own round-end bench run. State in
@@ -16,8 +18,12 @@ cd "$(dirname "$0")/.."
 DEADLINE="${1:?usage: tunnel_watch.sh <stop-epoch-seconds>}"
 LOG=/tmp/tunnel_watch.log
 STATE=/tmp/tunnel_watch.state
+SESSION_STATUS=/tmp/volsync_session_status.json
+export VOLSYNC_SESSION_STATUS="$SESSION_STATUS"
 
 note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$LOG"; echo "$*" > "$STATE"; }
+
+session() { python -m volsync_tpu.cli.main session "$@"; }
 
 note "watch started; deadline $(date -u -d @"$DEADLINE" +%H:%M:%S)"
 while true; do
@@ -26,15 +32,17 @@ while true; do
         note "deadline reached; exiting (tunnel never recovered)"
         exit 75
     fi
-    note "probing"
-    out=$(timeout -k 10 300 python -c \
-        "import jax; print('probe-ok', jax.default_backend())" 2>&1 \
-        | tail -1)
-    if [[ "$out" == *probe-ok*axon* || "$out" == *probe-ok*tpu* ]]; then
-        note "TUNNEL LIVE ($out) — measuring"
+    note "probing (volsync session status --probe)"
+    if timeout -k 10 360 python -m volsync_tpu.cli.main \
+            session status --probe --probe-timeout 300 \
+            >> "$LOG" 2>&1; then
+        note "TUNNEL LIVE — measuring"
         break
     fi
-    note "probe failed ($out); quiet for 55 min"
+    # One recovery action with known cause-and-effect, then quiet:
+    # sweep stale marked measurement children before going dark.
+    session recycle >> "$LOG" 2>&1 || true
+    note "probe failed; quiet for 55 min"
     # bail out early if the quiet period would cross the deadline
     if [ $(( $(date +%s) + 3300 )) -ge "$DEADLINE" ]; then
         note "next probe would cross the deadline; exiting"
@@ -46,8 +54,10 @@ done
 budget_left=$(( DEADLINE - $(date +%s) ))
 note "measurement budget: ${budget_left}s"
 
-# 1. Kernel/engine rungs -> BENCH_SELF_r05.json (each rung self-times;
-#    bench_self sleeps 10s between rungs for session settle).
+# 1. Kernel/engine rungs -> BENCH_SELF_r05.json. bench_self routes
+#    every rung through the session queue itself (verify probe, hard
+#    per-rung deadline, auto-recycle), so no outer timeout dance: just
+#    bound the whole ladder by the remaining budget.
 if [ "$budget_left" -gt 2600 ]; then
     timeout -k 20 $(( budget_left - 1500 > 7200 ? 7200 : budget_left - 1500 )) \
         python scripts/bench_self.py r05 2>&1 | tee -a "$LOG" | tail -20
@@ -64,22 +74,27 @@ else
 fi
 
 # 2. Service concurrency (the gRPC/microbatcher path), if time remains.
+#    Serialized behind a fresh verify probe like every other job.
 if [ $(( DEADLINE - $(date +%s) )) -gt 1400 ]; then
-    note "service_bench"
+    note "service_bench (via session run)"
     VOLSYNC_SVCBENCH_CLIENTS=8 VOLSYNC_SVCBENCH_MIB=64 \
-        timeout -k 20 1200 python scripts/service_bench.py \
+        session run --label service-bench --deadline 1200 \
+        -- python scripts/service_bench.py \
         > /tmp/service_bench.json 2>>"$LOG" || note "service_bench failed"
     tail -1 /tmp/service_bench.json >> "$LOG" 2>/dev/null || true
 fi
 
 # 3. Fleet scenario (configs[5]) if time remains.
 if [ $(( DEADLINE - $(date +%s) )) -gt 2000 ]; then
-    note "bench_scale fleet"
+    note "bench_scale fleet (via session run)"
     VOLSYNC_SCALE_MIB=8 VOLSYNC_SCALE_CRS=50 \
-        timeout -k 20 1800 python bench_scale.py fleet \
+        session run --label scale-fleet --deadline 1800 \
+        -- python bench_scale.py fleet \
         > /tmp/scale_fleet.json 2>>"$LOG" || note "fleet failed"
     tail -1 /tmp/scale_fleet.json >> "$LOG" 2>/dev/null || true
 fi
+
+session status >> "$LOG" 2>&1 || true
 
 # Commit whatever landed.
 git add -A BENCH_SELF_r05.json 2>/dev/null || true
@@ -87,7 +102,8 @@ if ! git diff --cached --quiet; then
     git commit -q -m "Live-chip measurements: BENCH_SELF_r05 (tunnel recovered mid-round)
 
 Recorded by the automated tunnel watcher the moment the wedged
-single-tenant tunnel came back; per-rung provenance in the artifact.
+single-tenant tunnel came back; per-rung session provenance in the
+artifact.
 
 No-Verification-Needed: automated measurement artifact, no source change" \
         && note "committed BENCH_SELF_r05.json"

@@ -6,6 +6,7 @@ test treatment as product code: error classification, per-config
 deadlines, the synthetic-volume generator, and the host gear reference.
 """
 
+import json
 import signal
 import time
 
@@ -122,6 +123,37 @@ def test_bench_provenance_shape(monkeypatch):
                                    "imported-uninitialized")
     extra = bench.bench_provenance(extra={"k": 1})
     assert extra["k"] == 1
+
+
+def test_bench_provenance_session_block(monkeypatch):
+    """Jobs launched through the session queue export VOLSYNC_SESSION_*
+    into the child environment; provenance must echo them so every
+    BENCH_*.json names the exact lease (and fencing epoch) it ran
+    under. Outside a session the block is absent, not fabricated."""
+    for var in ("VOLSYNC_SESSION_ID", "VOLSYNC_SESSION_EPOCH",
+                "VOLSYNC_SESSION_BACKEND"):
+        monkeypatch.delenv(var, raising=False)
+    assert "session" not in bench.bench_provenance()
+
+    monkeypatch.setenv("VOLSYNC_SESSION_ID", "fake-7")
+    monkeypatch.setenv("VOLSYNC_SESSION_EPOCH", "3")
+    monkeypatch.setenv("VOLSYNC_SESSION_BACKEND", "fake")
+    sess = bench.bench_provenance()["session"]
+    assert sess == {"id": "fake-7", "epoch": 3, "backend": "fake"}
+
+
+def test_emit_refuses_provenance_less_results(capsys):
+    """_emit is the choke point every bench result passes through; a
+    result without a provenance block is refused outright rather than
+    printed as an anonymous result line."""
+    with pytest.raises(ValueError, match="no provenance block"):
+        bench._emit({"metric": "m", "value": 1.0})
+    assert capsys.readouterr().out == ""
+
+    bench._emit({"metric": "m", "value": 1.0,
+                 "provenance": bench.bench_provenance()})
+    line = json.loads(capsys.readouterr().out)
+    assert line["provenance"]["platform"]
 
 
 def test_index_bench_smoke():

@@ -247,3 +247,130 @@ def test_injected_crash_at_op_n_recovers(tmp_path, src_tree, prefix, at):
     restore_snapshot(Repository.open(fs), dst)
     for f in sorted(p.name for p in src_tree.iterdir()):
         assert (dst / f).read_bytes() == (src_tree / f).read_bytes(), f
+
+
+def _backdate_locks(fs, *, seconds: float) -> int:
+    """Rewrite every lock object's timestamp ``seconds`` into the past —
+    the store-side fingerprint of a holder that crashed a while ago."""
+    import json
+    from datetime import datetime, timedelta, timezone
+
+    stamped = 0
+    when = (datetime.now(timezone.utc)
+            - timedelta(seconds=seconds)).isoformat()
+    for key in list(fs.list("locks/")):
+        info = json.loads(fs.get(key))
+        info["time"] = when
+        fs.put(key, json.dumps(info).encode())
+        stamped += 1
+    return stamped
+
+
+@pytest.mark.parametrize("op,prefix", [
+    ("put", "index/"),     # step 2: consolidated-index shard write
+    ("delete", "index/"),  # step 3: superseded delta delete
+    ("delete", "data/"),   # step 4: pack sweep
+], ids=["consolidated-index", "delta-delete", "pack-sweep"])
+def test_prune_crash_between_steps_keeps_snapshots_restorable(
+        tmp_path, src_tree, monkeypatch, op, prefix):
+    """Crash injected between each pair of prune's ordered steps
+    (rewrite+flush -> consolidated index -> delta delete -> pack
+    sweep): after every crash point, a fresh open must pass a full
+    read_data check, restore the surviving snapshot byte-identically,
+    and complete a retried prune — data is never deleted before its
+    replacement is durable.
+
+    The crashed holder leaves its EXCLUSIVE lock in the store (the
+    refresher's delete hits the dead store); recovery shortens
+    VOLSYNC_LOCK_STALE_S so a minute-old lock is treated as crashed
+    instead of stalling the restore behind the 30-minute default —
+    the operator knob repo/repository.py reads per instance."""
+    monkeypatch.setenv("VOLSYNC_LOCK_STALE_S", "5")
+    root = tmp_path / "store"
+    fs = FsObjectStore(str(root))
+    Repository.init(fs, chunker=CHUNKER)
+
+    repo = Repository.open(fs)
+    repo.PACK_TARGET = 64 * 1024
+    snap1, _ = TreeBackup(repo, workers=2).run(src_tree)
+    # rewrite one file wholesale: its old chunks become dead the moment
+    # snap1 is forgotten, making several packs partially live
+    rng = np.random.RandomState(11)
+    (src_tree / "f2.bin").write_bytes(rng.bytes(280_000))
+    snap2, _ = TreeBackup(repo, workers=2).run(src_tree)
+    assert snap1 and snap2 and snap1 != snap2
+    expect = {p.name: p.read_bytes() for p in src_tree.iterdir()}
+    repo.delete_snapshot(snap1)
+
+    faults = FaultStore(fs, FaultSchedule(seed=1, specs=[
+        FaultSpec(kind="crash", at=1, op=op, key_prefix=prefix)]))
+    pruning = Repository.open(faults)
+    pruning.PACK_TARGET = 64 * 1024
+    with pytest.raises(Exception, match="injected crash|store is dead"):
+        pruning.prune()
+    assert faults.crashed
+    # every crash point sits past at least one op of its kind: the
+    # injection actually fired inside prune, not before it
+    assert any(kind == "crash" and iop == op and key.startswith(prefix)
+               for (_, iop, key, kind) in faults.injected)
+
+    # the dead holder's exclusive lock is still there; age it past the
+    # shortened staleness horizon
+    assert _backdate_locks(fs, seconds=60) >= 1
+
+    fresh = Repository.open(fs)
+    assert fresh.LOCK_STALE_SECONDS == 5.0  # VOLSYNC_LOCK_STALE_S
+    assert fresh.check(read_data=True) == []
+    dst = tmp_path / "dst"
+    restore_snapshot(fresh, dst)
+    for name, data in expect.items():
+        assert (dst / name).read_bytes() == data, name
+
+    # the retried prune completes over the half-pruned store...
+    retry = Repository.open(fs)
+    retry.PACK_TARGET = 64 * 1024
+    retry.prune()
+    # ...and the snapshot STILL restores byte-identically
+    final = Repository.open(fs)
+    assert final.check(read_data=True) == []
+    dst2 = tmp_path / "dst2"
+    restore_snapshot(final, dst2)
+    for name, data in expect.items():
+        assert (dst2 / name).read_bytes() == data, name
+
+
+def test_stale_lock_horizon_and_age_gauge(tmp_path, src_tree, monkeypatch):
+    """The two halves of the lock-staleness knob: a conflicting lock
+    YOUNGER than VOLSYNC_LOCK_STALE_S blocks acquisition and publishes
+    its age on the volsync_repo_lock_age_seconds gauge; once past the
+    horizon it is swept as a crashed holder and acquisition proceeds."""
+    from volsync_tpu.metrics import GLOBAL as M
+    from volsync_tpu.repo.repository import RepoLockedError
+
+    monkeypatch.setenv("VOLSYNC_LOCK_STALE_S", "30")
+    root = tmp_path / "store"
+    fs = FsObjectStore(str(root))
+    Repository.init(fs, chunker=CHUNKER)
+    repo = Repository.open(fs)
+    assert repo.LOCK_STALE_SECONDS == 30.0
+
+    # a fresh foreign exclusive lock: young -> conflict + gauge
+    blocker = Repository.open(fs)
+    lock_cm = blocker.lock(exclusive=True)
+    lock_cm.__enter__()
+    try:
+        M.repo_lock_age.set(-1.0)
+        with pytest.raises(RepoLockedError):
+            with repo.lock(exclusive=False, wait_seconds=0.0):
+                pass
+        age = M.repo_lock_age._value.get()
+        assert 0.0 <= age <= 30.0
+    finally:
+        lock_cm.__exit__(None, None, None)
+
+    # a crashed holder's lock, aged past the horizon -> swept
+    orphan = blocker._write_lock(True)
+    assert _backdate_locks(fs, seconds=60) >= 1
+    with repo.lock(exclusive=False, wait_seconds=0.0):
+        pass  # acquired: the stale exclusive lock was removed
+    assert not fs.exists(orphan)

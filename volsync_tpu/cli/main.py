@@ -101,6 +101,10 @@ def build_parser() -> argparse.ArgumentParser:
         "trace", add_help=False,
         help="span flight recorder: dump Chrome-trace JSON / summary "
              "(volsync_tpu.obs)")
+    sub.add_parser(
+        "session", add_help=False,
+        help="supervised accelerator sessions: serialized bench jobs, "
+             "status, forced recycle (volsync_tpu.cluster.sessioncli)")
 
     return parser
 
@@ -116,6 +120,10 @@ def run(argv, contexts: dict, out=print) -> int:
         from volsync_tpu.obs.cli import main as trace_main
 
         return trace_main(list(argv[1:]), out=out)
+    if argv and argv[0] == "session":
+        from volsync_tpu.cluster.sessioncli import main as session_main
+
+        return session_main(list(argv[1:]), out=out)
     args = build_parser().parse_args(argv)
     config_dir = Path(args.config_dir)
     try:
@@ -161,12 +169,13 @@ def run(argv, contexts: dict, out=print) -> int:
 def main(argv=None) -> int:
     """Demo-mode entry: boot a full in-process stack as the 'default'
     context (the operator's packaged entry point wires real state).
-    ``volsync lint`` / ``volsync trace`` never need the runtime —
-    dispatch them before the boot so the linter runs in CI containers
-    with no cluster state and the flight recorder is readable from a
-    half-broken process."""
+    ``volsync lint`` / ``volsync trace`` / ``volsync session`` never
+    need the runtime — dispatch them before the boot so the linter runs
+    in CI containers with no cluster state, the flight recorder is
+    readable from a half-broken process, and ``session status`` works
+    on a host whose accelerator tunnel is wedged."""
     argv = argv if argv is not None else sys.argv[1:]
-    if argv and argv[0] in ("lint", "trace"):
+    if argv and argv[0] in ("lint", "trace", "session"):
         return run(argv, {})
     from volsync_tpu.operator import OperatorRuntime
 

@@ -152,6 +152,47 @@ class Metrics:
             "Seconds spent per stage by tenant-attributed spans",
             ["tenant", "stage"], registry=self.registry,
         )
+        # Repository store locking (repo/repository.py): age of the
+        # newest conflicting lock a waiter observed — a stale-holder
+        # stall shows as this gauge climbing toward
+        # VOLSYNC_LOCK_STALE_S instead of a silent 30-minute wait.
+        self.repo_lock_age = Gauge(
+            "volsync_repo_lock_age_seconds",
+            "Age of the most recent conflicting repository lock "
+            "observed while acquiring",
+            registry=self.registry,
+        )
+        # Supervised accelerator sessions (cluster/sessions.py):
+        # state machine position per backend (0=acquiring, 1=healthy,
+        # 2=degraded, 3=recycling), transition/recycle counts by cause,
+        # keepalive outcomes, and writes refused by fencing.
+        self.session_state = Gauge(
+            "volsync_session_state",
+            "Supervised session state per backend "
+            "(0=acquiring, 1=healthy, 2=degraded, 3=recycling)",
+            ["backend"], registry=self.registry,
+        )
+        self.session_transitions = Counter(
+            "volsync_session_transitions_total",
+            "Supervised session state transitions per backend",
+            ["backend", "to"], registry=self.registry,
+        )
+        self.session_recycles = Counter(
+            "volsync_session_recycles_total",
+            "Forced session recycles per backend, by cause",
+            ["backend", "cause"], registry=self.registry,
+        )
+        self.session_keepalives = Counter(
+            "volsync_session_keepalive_total",
+            "Session keepalive beats per backend, by outcome",
+            ["backend", "outcome"], registry=self.registry,
+        )
+        self.session_fenced_writes = Counter(
+            "volsync_session_fenced_writes_total",
+            "Results refused because the producing session's fencing "
+            "epoch was stale",
+            ["backend"], registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":

@@ -20,6 +20,11 @@ ObjectStore and injects faults according to a seeded ``FaultSchedule``:
                     error, and the store goes dead (every later call
                     fails too — in-flight worker threads cannot
                     quietly finish work the "dead" process started).
+- ``hang``        — the call blocks (``ms=`` per hit, default 60 s)
+                    past any caller-side deadline and THEN fails
+                    retryable — a stuck TCP connection that a NAT
+                    eventually reaps. The way to exercise
+                    ``DeadlineExceeded`` paths in chaos schedules.
 
 Determinism: probability rolls are a pure hash of
 ``(seed, spec, op, key, nth-occurrence-of(op,key))`` — independent of
@@ -67,8 +72,17 @@ class InjectedCrash(RuntimeError):
     resilience.classify says fatal) and sticky: the store is dead."""
 
 
+class InjectedHang(TransientError):
+    """A scheduled hang: the call consumed the caller's patience before
+    failing (retryable — but a deadline-aware policy has usually
+    already expired by the time this surfaces)."""
+
+
+#: default blocked time for a ``hang`` spec that carries no ``ms=``
+_HANG_DEFAULT_S = 60.0
+
 _KINDS = ("transient", "throttle", "latency", "partial_put",
-          "truncated_read", "crash")
+          "truncated_read", "crash", "hang")
 #: ops that mutate the store — the ones ``landed`` applies to
 _WRITE_OPS = ("put", "put_if_absent", "delete")
 
@@ -219,13 +233,19 @@ class FaultStore:
         crash = next((s for s in fired if s.kind == "crash"), None)
         err = next((s for s in fired
                     if s.kind in ("transient", "throttle", "partial_put",
-                                  "truncated_read")), None)
+                                  "truncated_read", "hang")), None)
         if crash is not None:
             if crash.landed and op in _WRITE_OPS:
                 execute()
             raise InjectedCrash(f"injected crash at {op} {key!r}")
         if err is None:
             return execute()
+        if err.kind == "hang":
+            # Block past the caller's deadline, then surface as a drop
+            # (the op never reached the store — nothing lands).
+            self._sleep(err.latency if err.latency > 0
+                        else _HANG_DEFAULT_S)
+            raise InjectedHang(f"injected hang at {op} {key!r}")
         if err.kind == "partial_put" and torn_execute is not None:
             torn_execute()
             raise FaultInjected(f"injected torn write at {op} {key!r}")

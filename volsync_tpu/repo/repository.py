@@ -226,6 +226,11 @@ class Repository:
         self._pl_error: Optional[Exception] = None
         self._g_seal = GLOBAL_METRICS.pipeline_depth.labels(stage="seal")
         self._g_upload = GLOBAL_METRICS.pipeline_depth.labels(stage="upload")
+        # Staleness horizon read per instance (VOLSYNC_LOCK_STALE_S)
+        # so an operator can shorten the wait on a known-dead holder
+        # without editing code; the class attribute stays as the
+        # documented default for direct patching in tests.
+        self.LOCK_STALE_SECONDS = envflags.lock_stale_seconds()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -344,6 +349,10 @@ class Repository:
                 self.store.delete(key)  # crashed holder
                 continue
             if exclusive or info.get("exclusive"):
+                # Make the wait observable: a waiter stalled behind a
+                # dying holder shows as this gauge climbing toward
+                # LOCK_STALE_SECONDS instead of a silent stall.
+                GLOBAL_METRICS.repo_lock_age.set(max(age, 0.0))
                 return key
         return None
 
