@@ -2,7 +2,7 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint test chaos static-check bench-index-smoke \
+.PHONY: lint test chaos chaos-concurrent static-check bench-index-smoke \
 	service-bench-smoke trace-smoke session-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
@@ -23,6 +23,20 @@ test:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_resilience.py tests/test_crash_recovery.py \
+	    -q -m 'not slow' -p no:cacheprovider
+
+# Multi-writer chaos acceptance (docs/robustness.md): 4 concurrent
+# fenced writers + a two-phase pruner under the MW_SCHEDULES seeded
+# fault/crash matrix in tests/test_chaos.py (crash at every new prune
+# step boundary plus a forced double-takeover), ending in a clean
+# check(read_data=True) and byte-identical restores, plus the
+# single-writer two-phase manifest-boundary crashes and the
+# multi-writer protocol unit suite.
+chaos-concurrent:
+	JAX_PLATFORMS=cpu python -m pytest \
+	    "tests/test_chaos.py::test_chaos_multiwriter_prune" \
+	    "tests/test_crash_recovery.py::test_two_phase_prune_crash_at_manifest_boundaries" \
+	    tests/test_multiwriter.py \
 	    -q -m 'not slow' -p no:cacheprovider
 
 static-check:

@@ -27,6 +27,12 @@
 #      FakeSessionBackend chaos — wedge -> recycle -> job completes,
 #      zombie write fenced, deterministic transition trace
 #      (docs/sessions.md).
+#   7. The multi-writer chaos acceptance (`make chaos-concurrent`):
+#      4 fenced concurrent writers + a two-phase pruner under the
+#      seeded MW_SCHEDULES fault/crash matrix — crash at every prune
+#      step boundary, forced double-takeover — always ending in a
+#      clean check(read_data=True) with byte-identical restores
+#      (docs/robustness.md, "Multi-writer protocol").
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -52,5 +58,8 @@ make --no-print-directory trace-smoke
 
 echo "== session-smoke =="
 make --no-print-directory session-smoke
+
+echo "== chaos-concurrent =="
+make --no-print-directory chaos-concurrent
 
 echo "static_check: OK"

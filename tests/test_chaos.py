@@ -17,7 +17,10 @@ fatal, the backup dies like a killed mover pod, and a fresh open must
 see a consistent repository whose retry fully restores.
 """
 
+import json
 import threading
+import time
+from datetime import datetime, timedelta, timezone
 
 import numpy as np
 import pytest
@@ -284,3 +287,237 @@ def test_chaos_crash_midupload_then_recover(tmp_path):
     snap, _ = TreeBackup(fresh, workers=2).run(src)
     assert snap
     _assert_consistent_and_restorable(fs, src, tmp_path / "dst")
+
+
+# -- multi-writer soak: fenced writers + concurrent two-phase prune --------
+
+
+def _age_locks(fs, *, seconds: float) -> int:
+    """Rewrite every lock object's refresh stamp ``seconds`` into the
+    past — the store-side fingerprint of holders that crashed a while
+    ago (same trick as tests/test_crash_recovery.py)."""
+    stamped = 0
+    when = (datetime.now(timezone.utc)
+            - timedelta(seconds=seconds)).isoformat()
+    for key in list(fs.list("locks/")):
+        info = json.loads(fs.get(key))
+        info["time"] = when
+        fs.put(key, json.dumps(info).encode())
+        stamped += 1
+    return stamped
+
+
+def _writer_tree(tmp_path, t):
+    rng = np.random.RandomState(40 + t)
+    src = tmp_path / f"w{t}"
+    src.mkdir()
+    for i in range(3):
+        (src / f"f{i}.bin").write_bytes(rng.bytes(90_000 + 13 * i + 7 * t))
+    return src
+
+
+def _seed_garbage(fs, tmp_path):
+    """One kept snapshot plus dead blobs (a deleted snapshot's unique
+    chunks), so the concurrent pruner has partially-live packs to
+    rewrite and victims to mark."""
+    pre = tmp_path / "pre"
+    pre.mkdir()
+    rng = np.random.RandomState(77)
+    for i in range(4):
+        (pre / f"g{i}.bin").write_bytes(rng.bytes(150_000 + 11 * i))
+    repo = Repository.open(fs)
+    repo.PACK_TARGET = 64 * 1024
+    doomed, _ = TreeBackup(repo, workers=1).run(pre)
+    for i in range(2):  # rewrite HALF the files: packs go partially live
+        (pre / f"g{i}.bin").write_bytes(rng.bytes(150_000 + 11 * i))
+    kept, _ = TreeBackup(repo, workers=1).run(pre)
+    repo.delete_snapshot(doomed)
+    return pre, kept
+
+
+#: Multi-writer soak matrix — every schedule runs 4 concurrent backup
+#: writers (each its OWN Repository over its own chaos stack: distinct
+#: writer ids, real multi-writer fencing) plus 1 concurrent two-phase
+#: pruner over the same backing store. Three spec slots:
+#:
+#: - ``writer_specs`` — weather on the writers' stores (retries absorb;
+#:   the ``at=N`` entries fire deterministically so the "schedule never
+#:   fired" assert cannot flake);
+#: - ``pruner_specs`` — faults on the CONCURRENT pruner; a ``crash``
+#:   kills it mid-protocol like a killed pod, its lingering lock is
+#:   aged past the staleness horizon, and a retried prune must take
+#:   over (fencing the dead writer) and complete;
+#: - ``sweep_specs`` — faults on the LATER sweeping prune (the one that
+#:   collects the expired pending-delete manifest).
+#:
+#: The crash schedules put ``at=1`` on each write boundary the
+#: two-phase protocol added on top of the PR 9 matrix (tests/
+#: test_crash_recovery.py covers the grace=0 boundaries): the
+#: pending-delete manifest put, the consolidated-shard put, the
+#: superseded-delta delete, the pack sweep delete, and the manifest
+#: sweep delete. ``mw-double-takeover`` pre-ages a zombie peer's lock so
+#: all five participants observe it at once — the atomic takeover
+#: marker must let exactly ONE win.
+MW_SCHEDULES = [
+    ("mw-transient", 1101, dict(
+        writer_specs=[FaultSpec(kind="transient", p=0.15),
+                      FaultSpec(kind="throttle", p=0.05),
+                      FaultSpec(kind="transient", at=3)])),
+    ("mw-index-partial-put", 1202, dict(
+        writer_specs=[FaultSpec(kind="partial_put", at=1, op="put",
+                                key_prefix="index/"),
+                      FaultSpec(kind="latency", p=0.2, latency=0.001)])),
+    ("mw-crash-mark-manifest", 1303, dict(
+        pruner_specs=[FaultSpec(kind="crash", at=1, op="put",
+                                key_prefix="pending-delete/")])),
+    ("mw-crash-consolidate", 1404, dict(
+        pruner_specs=[FaultSpec(kind="crash", at=1, op="put",
+                                key_prefix="index/")])),
+    ("mw-crash-delta-delete", 1505, dict(
+        pruner_specs=[FaultSpec(kind="crash", at=1, op="delete",
+                                key_prefix="index/")])),
+    ("mw-crash-sweep-pack", 1606, dict(
+        sweep_specs=[FaultSpec(kind="crash", at=1, op="delete",
+                               key_prefix="data/")])),
+    ("mw-crash-sweep-manifest", 1707, dict(
+        sweep_specs=[FaultSpec(kind="crash", at=1, op="delete",
+                               key_prefix="pending-delete/")])),
+    ("mw-double-takeover", 1808, dict(
+        stale_lock=True,
+        writer_specs=[FaultSpec(kind="transient", p=0.10),
+                      FaultSpec(kind="transient", at=3)])),
+]
+
+
+@pytest.mark.parametrize("name,seed,cfg", MW_SCHEDULES,
+                         ids=[s[0] for s in MW_SCHEDULES])
+def test_chaos_multiwriter_prune(tmp_path, monkeypatch, name, seed, cfg):
+    """4 concurrent fenced writers + 1 concurrent two-phase pruner under
+    a seeded fault/crash schedule. Whatever the schedule does, the end
+    state must be: clean ``check(read_data=True)``, every landed
+    snapshot restores byte-identically, no index entry references a
+    missing pack (no live pack was swept), and a final prune leaves no
+    pending-delete debris."""
+    from volsync_tpu.metrics import GLOBAL as METRICS
+
+    monkeypatch.setenv("VOLSYNC_LOCK_STALE_S", "5")
+    writer_specs = cfg.get("writer_specs", [])
+    pruner_specs = cfg.get("pruner_specs", [])
+    sweep_specs = cfg.get("sweep_specs", [])
+    root = tmp_path / "store"
+    fs = FsObjectStore(str(root))
+    Repository.init(fs, chunker=CHUNKER)
+    pre, kept = _seed_garbage(fs, tmp_path)
+
+    zombie_writer = None
+    if cfg.get("stale_lock"):
+        zombie = Repository.open(fs)
+        zombie._write_lock("shared")
+        zombie_writer = zombie.writer_id
+        assert _age_locks(fs, seconds=60) >= 1
+        takeovers_before = METRICS.repo_takeovers_total._value.get()
+
+    trees = [_writer_tree(tmp_path, t) for t in range(4)]
+    stacks = [_chaos_stack(root, seed + t, writer_specs)
+              for t in range(4)]
+    _p_fs, p_faults, p_top = _chaos_stack(root, seed + 99, pruner_specs)
+    barrier = threading.Barrier(5)
+    snaps: list = [None] * 4
+    errors: list = []
+    prune_error: list = []
+
+    def writer(t):
+        try:
+            repo = Repository.open(stacks[t][2])
+            repo.PACK_TARGET = 64 * 1024
+            # losers of a takeover race back out and re-poll; give them
+            # room instead of the 0-second default
+            repo.default_lock_wait = 10.0
+            barrier.wait(timeout=60)
+            snap, _ = TreeBackup(repo, workers=1).run(
+                trees[t], hostname=f"writer{t}")
+            snaps[t] = snap
+        except Exception as e:  # surfaced via the errors assert below
+            errors.append((t, e))
+
+    def pruner():
+        try:
+            repo = Repository.open(p_top)
+            repo.default_lock_wait = 10.0
+            barrier.wait(timeout=60)
+            repo.prune(grace_seconds=0.2)
+        except Exception as e:  # crash schedules EXPECT this
+            prune_error.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,),
+                                name=f"mw-writer-{t}") for t in range(4)]
+    threads.append(threading.Thread(target=pruner, name="mw-pruner"))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+
+    if any(s.kind == "crash" for s in pruner_specs):
+        # the pruner died mid-protocol; its lock lingers (refresher's
+        # delete hit the dead store). Age it, then a retried prune must
+        # take over — fencing the dead pruner's writer id — and finish.
+        assert prune_error and p_faults.crashed
+        assert _age_locks(fs, seconds=60) >= 1
+        retry = Repository.open(fs)
+        retry.default_lock_wait = 10.0
+        retry.prune(grace_seconds=0.2)
+        fenced = list(fs.list("fenced/"))
+        assert fenced, "takeover of the crashed pruner never fenced it"
+    else:
+        assert not prune_error, prune_error
+    if writer_specs:
+        assert all(st[1].injected for st in stacks), \
+            "a writer schedule never fired — soak tested nothing"
+
+    if zombie_writer is not None:
+        # exactly one participant won the takeover of the pre-aged lock
+        assert (METRICS.repo_takeovers_total._value.get()
+                == takeovers_before + 1)
+        assert fs.exists(f"fenced/{zombie_writer}")
+        assert list(fs.list("takeover/")) == []  # marker cleaned up
+
+    # grace expired + every writer lock released -> the sweep gate is
+    # open; collect the marked victims (through a faulted stack when
+    # the schedule targets the sweep phase)
+    time.sleep(0.3)
+    if sweep_specs:
+        _s_fs, s_faults, s_top = _chaos_stack(root, seed + 7, sweep_specs)
+        sweeper = Repository.open(s_top)
+        sweeper.default_lock_wait = 10.0
+        with pytest.raises(Exception, match="injected crash|store is dead"):
+            sweeper.prune(grace_seconds=0.2)
+        assert s_faults.crashed
+        assert _age_locks(fs, seconds=60) >= 1
+    final = Repository.open(fs)
+    final.default_lock_wait = 10.0
+    final.prune(grace_seconds=0.2)
+    assert list(fs.list("pending-delete/")) == [], \
+        "retried prune left pending-delete debris"
+
+    # end-to-end contract, through the UNFAULTED store
+    check = Repository.open(fs)
+    assert check.check(read_data=True) == []
+    ids = [s[0] for s in check.list_snapshots()]
+    assert all(snaps) and set(snaps) <= set(ids)
+    for t in range(4):
+        dst = tmp_path / f"dst{t}"
+        prev = len(ids) - 1 - ids.index(snaps[t])
+        restore_snapshot(Repository.open(fs), dst, previous=prev)
+        for f in sorted(p.name for p in trees[t].iterdir()):
+            assert (dst / f).read_bytes() == (trees[t] / f).read_bytes(), f
+    dstk = tmp_path / "dstk"
+    prev = len(ids) - 1 - ids.index(kept)
+    restore_snapshot(Repository.open(fs), dstk, previous=prev)
+    for f in sorted(p.name for p in pre.iterdir()):
+        assert (dstk / f).read_bytes() == (pre / f).read_bytes(), f
+    with check._lock:
+        packs = [p for p in check._index.live_packs() if p]
+    for p in packs:
+        assert fs.exists(f"data/{p[:2]}/{p}"), \
+            f"index references missing pack {p}"

@@ -249,3 +249,71 @@ def test_concurrent_inserts_are_all_visible():
     every = [h for p in parts for h in p]
     assert len(idx) == len(every)
     assert idx.contains_many(every).all()
+
+
+def test_snapshot_arrays_remap_under_concurrent_inserts():
+    """snapshot_arrays merges per-shard pack_names into one global list
+    by remapping each shard's local pack codes. Four writer threads
+    share a small pool of pack names, so every shard interns the SAME
+    packs in a DIFFERENT local order — any remap bug (stale local code,
+    off-by-one on the merged list) surfaces as a key attributed to the
+    wrong pack. A snapshotter races the writers the whole time: each
+    snapshot it takes need not be a point-in-time cut, but must always
+    be internally consistent and never mis-attribute a key."""
+    idx = ShardedBlobIndex(shards=8, capacity=16)
+    rng = np.random.RandomState(29)
+    parts = [hex_ids(rng, 300) for _ in range(4)]
+    packs = [f"pack-{c}" for c in "abcdefg"]
+    expect = {}  # hex id -> pack name, every id inserted exactly once
+    for w, part in enumerate(parts):
+        for i, h in enumerate(part):
+            expect[h] = packs[(w + i) % len(packs)]
+    expect_raw = {bytes.fromhex(k): v for k, v in expect.items()}
+
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def writer(part, w):
+        for i, h in enumerate(part):
+            idx.insert(h, expect[h], "data", i, 1, 1)
+
+    def snapshotter():
+        while not stop.is_set():
+            keys, codes, names = idx.snapshot_arrays()
+            if len(names) != len(set(names)):
+                errors.append(f"duplicate pack names: {names}")
+                return
+            if codes.shape[0] and int(codes.max()) >= len(names):
+                errors.append(
+                    f"code {int(codes.max())} out of range {len(names)}")
+                return
+            raw = keys.tobytes()
+            for i, c in enumerate(codes.tolist()):
+                k = raw[i * 32:(i + 1) * 32]
+                if names[c] != expect_raw[k]:
+                    errors.append(
+                        f"{k.hex()} attributed to {names[c]}, "
+                        f"expected {expect_raw[k]}")
+                    return
+
+    threads = [threading.Thread(target=writer, args=(p, w),
+                                name=f"test-remap-writer-{w}")
+               for w, p in enumerate(parts)]
+    snap = threading.Thread(target=snapshotter, name="test-remap-snap")
+    snap.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    snap.join()
+    assert errors == []
+
+    # the settled snapshot IS a point-in-time cut: exact contents
+    keys, codes, names = idx.snapshot_arrays()
+    raw = keys.tobytes()
+    got = {raw[i * 32:(i + 1) * 32]: names[c]
+           for i, c in enumerate(codes.tolist())}
+    assert got == expect_raw
+    assert set(names) == set(packs)
+    assert idx.live_packs() == set(packs)

@@ -185,7 +185,7 @@ def test_prune_sweeps_crash_orphans(tmp_path, src_tree):
     before = set(fs.list("data/"))
 
     repo2 = Repository.open(fs)
-    repo2.prune()
+    repo2.prune(grace_seconds=0)  # stop-the-world: sweep in this call
     after = set(fs.list("data/"))
 
     repo3 = Repository.open(fs)
@@ -307,7 +307,7 @@ def test_prune_crash_between_steps_keeps_snapshots_restorable(
     pruning = Repository.open(faults)
     pruning.PACK_TARGET = 64 * 1024
     with pytest.raises(Exception, match="injected crash|store is dead"):
-        pruning.prune()
+        pruning.prune(grace_seconds=0)
     assert faults.crashed
     # every crash point sits past at least one op of its kind: the
     # injection actually fired inside prune, not before it
@@ -329,8 +329,87 @@ def test_prune_crash_between_steps_keeps_snapshots_restorable(
     # the retried prune completes over the half-pruned store...
     retry = Repository.open(fs)
     retry.PACK_TARGET = 64 * 1024
-    retry.prune()
+    retry.prune(grace_seconds=0)
     # ...and the snapshot STILL restores byte-identically
+    final = Repository.open(fs)
+    assert final.check(read_data=True) == []
+    dst2 = tmp_path / "dst2"
+    restore_snapshot(final, dst2)
+    for name, data in expect.items():
+        assert (dst2 / name).read_bytes() == data, name
+
+
+@pytest.mark.parametrize("phase,op,prefix", [
+    ("mark", "put", "pending-delete/"),
+    ("sweep", "delete", "pending-delete/"),
+], ids=["mark-manifest", "sweep-manifest"])
+def test_two_phase_prune_crash_at_manifest_boundaries(
+        tmp_path, src_tree, monkeypatch, phase, op, prefix):
+    """The two write boundaries the two-phase protocol ADDS on top of
+    the classic prune ordering: the pending-delete manifest put (mark)
+    and the manifest delete that retires a completed sweep. A crash at
+    either must leave the store fully checkable and restorable, and a
+    retried prune must converge to an empty pending-delete/ namespace —
+    a manifest is never the only record standing between live data and
+    deletion, in either direction."""
+    import time
+
+    monkeypatch.setenv("VOLSYNC_LOCK_STALE_S", "5")
+    root = tmp_path / "store"
+    fs = FsObjectStore(str(root))
+    Repository.init(fs, chunker=CHUNKER)
+
+    repo = Repository.open(fs)
+    repo.PACK_TARGET = 64 * 1024
+    snap1, _ = TreeBackup(repo, workers=2).run(src_tree)
+    rng = np.random.RandomState(11)
+    (src_tree / "f2.bin").write_bytes(rng.bytes(280_000))
+    snap2, _ = TreeBackup(repo, workers=2).run(src_tree)
+    assert snap1 and snap2 and snap1 != snap2
+    expect = {p.name: p.read_bytes() for p in src_tree.iterdir()}
+    repo.delete_snapshot(snap1)
+
+    if phase == "sweep":
+        # mark cleanly first; the fault fires in the later sweep pass
+        marker = Repository.open(fs)
+        marker.PACK_TARGET = 64 * 1024
+        stats = marker.prune(grace_seconds=0.2)
+        assert stats["packs_pending"] > 0
+        assert list(fs.list("pending-delete/"))
+        time.sleep(0.3)  # let the grace deadline pass
+
+    faults = FaultStore(fs, FaultSchedule(seed=1, specs=[
+        FaultSpec(kind="crash", at=1, op=op, key_prefix=prefix)]))
+    pruning = Repository.open(faults)
+    pruning.PACK_TARGET = 64 * 1024
+    with pytest.raises(Exception, match="injected crash|store is dead"):
+        pruning.prune(grace_seconds=0.2)
+    assert faults.crashed
+    assert any(kind == "crash" and iop == op and key.startswith(prefix)
+               for (_, iop, key, kind) in faults.injected)
+
+    # the dead pruner's lock survives it; age it past the horizon
+    assert _backdate_locks(fs, seconds=60) >= 1
+
+    # crash-at-mark leaves no manifest (the put never landed);
+    # crash-at-retire leaves one pointing at already-swept packs —
+    # both must read as a healthy repository
+    fresh = Repository.open(fs)
+    assert fresh.check(read_data=True) == []
+    dst = tmp_path / "dst"
+    restore_snapshot(fresh, dst)
+    for name, data in expect.items():
+        assert (dst / name).read_bytes() == data, name
+
+    # the retried prune re-marks (or retires the leftover manifest),
+    # and once the grace deadline passes a final pass sweeps everything
+    retry = Repository.open(fs)
+    retry.PACK_TARGET = 64 * 1024
+    retry.prune(grace_seconds=0.2)
+    time.sleep(0.3)
+    Repository.open(fs).prune(grace_seconds=0.2)
+    assert list(fs.list("pending-delete/")) == []
+
     final = Repository.open(fs)
     assert final.check(read_data=True) == []
     dst2 = tmp_path / "dst2"

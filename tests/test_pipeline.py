@@ -13,6 +13,8 @@ repository API the serial path uses, so the contract is strong:
     within their configured bounds, so buffered bytes are bounded.
 """
 
+import re
+
 import numpy as np
 import pytest
 
@@ -58,7 +60,19 @@ def _backup(pipelined: bool, blobs, store=None, pack_target=16 * 1024,
 
 
 def _objects(store, skip=("config",)):
-    return {k: store.get(k) for k in store.list("") if k not in skip}
+    """Store contents keyed by name, with the two legitimately random
+    per-instance values canonicalized: the repository id lives in the
+    skipped config object, and index delta names embed the writer's
+    random identity (index/<gen>-<writer>-<contenthash>) — collapse
+    the writer segment so serial and pipelined runs stay comparable."""
+    out = {}
+    for k in store.list(""):
+        if k in skip:
+            continue
+        canon = re.sub(r"^(index/\d+)-[0-9a-f]+-", r"\1-WRITER-", k)
+        assert canon not in out, f"canonicalized key collision: {canon}"
+        out[canon] = store.get(k)
+    return out
 
 
 class FailingStore:

@@ -211,7 +211,7 @@ def test_prune_drops_unreferenced_blobs(tmp_path, rng):
     # drop the first snapshot, prune, and verify its blobs are gone
     first = repo.list_snapshots()[0][0]
     repo.delete_snapshot(first)
-    report = repo.prune()
+    report = repo.prune(grace_seconds=0)  # stop-the-world semantics
     assert report["blobs_removed"] > 0
     assert len(repo.blob_ids()) < all_blobs
     assert repo.check(read_data=True) == []
@@ -268,7 +268,7 @@ def test_lock_shared_blocks_exclusive_and_vice_versa():
 
 def test_lock_stale_holder_is_removed():
     repo = make_repo()
-    own = repo._write_lock(exclusive=True)
+    own = repo._write_lock("exclusive")
     info = json.loads(repo.store.get(own))
     info["time"] = (datetime.now(timezone.utc)
                     - timedelta(seconds=Repository.LOCK_STALE_SECONDS + 60)

@@ -154,7 +154,7 @@ def test_prune_reads_packs_concurrently(monkeypatch):
         return real_get_range(key, offset, length)
 
     monkeypatch.setattr(store, "get_range", spy)
-    stats = repo.prune()
+    stats = repo.prune(grace_seconds=0)
     assert stats["blobs_removed"] == len(doom_ids)
     assert stats["packs_rewritten"] >= 2
     # The rewrite readers ran on pool threads (overlapped IO), not the
@@ -196,7 +196,7 @@ def test_prune_writes_sharded_index(monkeypatch):
     repo.flush()
     repo.save_snapshot({"hostname": "t", "paths": [], "tags": [],
                         "tree": tid, "parent": None, "stats": {}})
-    repo.prune()
+    repo.prune(grace_seconds=0)
     shards = list(store.list("index/"))
     assert len(shards) >= 3  # 21 entries / limit 4 -> many shards
     reopened = Repository.open(store)
@@ -254,7 +254,7 @@ def test_prune_survives_nul_tailed_blob_ids(monkeypatch):
                         "tree": tid, "parent": None, "stats": {}})
 
     assert keep_id in repo.referenced_blobs()  # hex survives extraction
-    stats = repo.prune()  # must not raise on the NUL-tailed ids
+    stats = repo.prune(grace_seconds=0)  # must not raise on NUL-tailed ids
     assert stats["blobs_removed"] == 1
     assert repo.read_blob(keep_id) == keep_data
     assert not repo.has_blob(doom_id)

@@ -293,10 +293,13 @@ def test_service_closed_loop_trace_acceptance():
 
     params = GearParams(min_size=64 * 1024, avg_size=128 * 1024,
                         max_size=256 * 1024, align=4096)
+    # 4 requests per client: stage_coverage divides by the measured
+    # p50, and a median over 2 samples lets one ambient-load straggler
+    # (whose stall lands between spans) flake the 0.9 gate
     res = run_closed_loop(
         tenants=[{"name": "gold", "weight": 4, "clients": 1},
                  {"name": "bronze", "weight": 1, "clients": 1}],
-        requests_per_client=2, mib_per_request=1, segment_kib=128,
+        requests_per_client=4, mib_per_request=1, segment_kib=128,
         window_ms=5.0, params=params, warm=False)
     assert res["mid_stream_aborts"] == []
 

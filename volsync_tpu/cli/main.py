@@ -105,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
         "session", add_help=False,
         help="supervised accelerator sessions: serialized bench jobs, "
              "status, forced recycle (volsync_tpu.cluster.sessioncli)")
+    sub.add_parser(
+        "repair", add_help=False,
+        help="repository recovery: orphaned packs, expired "
+             "pending-deletes, dangling index entries "
+             "(volsync_tpu.cli.repair)")
 
     return parser
 
@@ -124,6 +129,10 @@ def run(argv, contexts: dict, out=print) -> int:
         from volsync_tpu.cluster.sessioncli import main as session_main
 
         return session_main(list(argv[1:]), out=out)
+    if argv and argv[0] == "repair":
+        from volsync_tpu.cli.repair import main as repair_main
+
+        return repair_main(list(argv[1:]), out=out)
     args = build_parser().parse_args(argv)
     config_dir = Path(args.config_dir)
     try:
@@ -169,13 +178,15 @@ def run(argv, contexts: dict, out=print) -> int:
 def main(argv=None) -> int:
     """Demo-mode entry: boot a full in-process stack as the 'default'
     context (the operator's packaged entry point wires real state).
-    ``volsync lint`` / ``volsync trace`` / ``volsync session`` never
-    need the runtime — dispatch them before the boot so the linter runs
-    in CI containers with no cluster state, the flight recorder is
-    readable from a half-broken process, and ``session status`` works
-    on a host whose accelerator tunnel is wedged."""
+    ``volsync lint`` / ``volsync trace`` / ``volsync session`` /
+    ``volsync repair`` never need the runtime — dispatch them before
+    the boot so the linter runs in CI containers with no cluster state,
+    the flight recorder is readable from a half-broken process,
+    ``session status`` works on a host whose accelerator tunnel is
+    wedged, and repair can run against a store whose operator stack is
+    exactly what crashed."""
     argv = argv if argv is not None else sys.argv[1:]
-    if argv and argv[0] in ("lint", "trace", "session"):
+    if argv and argv[0] in ("lint", "trace", "session", "repair"):
         return run(argv, {})
     from volsync_tpu.operator import OperatorRuntime
 

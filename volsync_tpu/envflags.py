@@ -311,6 +311,23 @@ def lock_stale_seconds() -> float:
     return env_float("VOLSYNC_LOCK_STALE_S", 30.0 * 60.0, minimum=1.0)
 
 
+def prune_grace_seconds() -> Optional[float]:
+    """VOLSYNC_PRUNE_GRACE_S: grace a two-phase prune grants marked
+    (pending-delete) victim packs before the sweep may delete them.
+    Unset (the default) means "use the lock-staleness horizon", which
+    guarantees any writer that could still dedup against a victim pack
+    either shows a live lock (blocking the sweep) or has crashed. ``0``
+    selects the classic stop-the-world prune: exclusive lock, victims
+    swept in the same call."""
+    raw = env_str("VOLSYNC_PRUNE_GRACE_S")
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(raw.strip()))
+    except ValueError:
+        return None
+
+
 # -- supervised accelerator sessions (cluster/sessions.py) ----------------
 
 def session_ttl_seconds() -> float:

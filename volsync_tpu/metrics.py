@@ -162,6 +162,35 @@ class Metrics:
             "observed while acquiring",
             registry=self.registry,
         )
+        # Multi-writer repository protocol (repo/repository.py): the
+        # writer's current fencing generation, packs parked in
+        # pending-delete/ manifests awaiting their grace deadline,
+        # stale-lock takeovers won (each bumps the generation and
+        # fences the victim writer), and publishes refused because this
+        # writer had been fenced by a peer's takeover.
+        self.repo_writer_generation = Gauge(
+            "volsync_repo_writer_generation",
+            "Current repository fencing generation of this writer",
+            registry=self.registry,
+        )
+        self.repo_pending_delete_packs = Gauge(
+            "volsync_repo_pending_delete_packs",
+            "Packs marked pending-delete and awaiting their sweep "
+            "grace deadline",
+            registry=self.registry,
+        )
+        self.repo_takeovers_total = Counter(
+            "volsync_repo_takeovers_total",
+            "Stale repository locks atomically taken over (victim "
+            "writer fenced, generation bumped)",
+            registry=self.registry,
+        )
+        self.repo_fenced_publishes_total = Counter(
+            "volsync_repo_fenced_publishes_total",
+            "Index/snapshot publishes refused because this writer was "
+            "fenced by a stale-lock takeover",
+            registry=self.registry,
+        )
         # Supervised accelerator sessions (cluster/sessions.py):
         # state machine position per backend (0=acquiring, 1=healthy,
         # 2=degraded, 3=recycling), transition/recycle counts by cause,

@@ -44,6 +44,10 @@ Spec strings (``parse_spec``): semicolon-separated entries
 ``kind:key=value,...`` e.g. ::
 
     transient:p=0.05,op=put;latency:p=0.1,ms=2;crash:at=40,op=put,prefix=data/
+
+``op`` accepts a pipe-separated list (``op=put|delete``) so one
+crash-at-op-N counter can span every write boundary of a multi-op
+protocol, e.g. the two-phase prune's mark/sweep steps.
 """
 
 from __future__ import annotations
@@ -94,13 +98,17 @@ class FaultSpec:
     kind: str                  # one of _KINDS
     p: float = 0.0             # probability per matching op
     at: Optional[int] = None   # fire at the Nth matching op (1-based)
-    op: str = "*"              # op name filter ("*" = any)
+    op: str = "*"              # op filter: "*", one name, or "a|b|c"
     key_prefix: str = ""       # key startswith filter
     landed: bool = False       # write ops: inner op completes first
     latency: float = 0.0       # seconds, for kind="latency"
 
     def matches(self, op: str, key: str) -> bool:
-        if self.op != "*" and op != self.op:
+        # ``op`` accepts a pipe-separated list ("put|delete") so one
+        # crash counter can span every write stage of a multi-op
+        # protocol (the two-phase prune's chaos schedules need
+        # crash-at-op-N across its put AND delete boundaries).
+        if self.op != "*" and op not in self.op.split("|"):
             return False
         return key.startswith(self.key_prefix)
 

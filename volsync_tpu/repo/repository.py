@@ -11,8 +11,19 @@ msgpack/json + zstd, sealed by repo/crypto.py when a password is set.
 Layout in the object store:
     config                      repo id, chunker params, KDF salt+verifier
     data/<p2>/<pack-id>         packs: sealed blob segments + sealed header
-    index/<id>                  sealed, compressed index delta
+    index/<gen>-<writer>-<id>   sealed, compressed index delta (per writer;
+                                bare index/<id> from older writers still loads)
     snapshots/<id>              sealed snapshot manifest
+    locks/<id>                  live writer/pruner lock objects
+    gen/<n>                     fencing generation stamps (max = current)
+    takeover/<lock-id>          atomic claim to remove one stale lock
+    fenced/<writer-id>          fence marker: that writer's publishes refuse
+    pending-delete/<id>         two-phase prune manifests (marked packs)
+
+Multi-writer protocol (docs/robustness.md): N concurrent backup writers
+plus one prune-mode pruner share a repository; generation fencing
+refuses a taken-over zombie's late publishes, and prune is mark-then-
+sweep with a grace period no shorter than the lock-staleness horizon.
 """
 
 from __future__ import annotations
@@ -34,7 +45,7 @@ from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
 from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
-from volsync_tpu.obs import carry_context, span
+from volsync_tpu.obs import carry_context, record_trigger, span
 from volsync_tpu.repo import blobid, crypto
 from volsync_tpu.repo.shardedindex import ShardedBlobIndex
 from volsync_tpu.repo.compress import Compressor, Decompressor
@@ -67,6 +78,18 @@ class RepoLockedError(RepoError):
 class UploadError(RepoError):
     """A pack upload failed after retries; the pack was NOT registered,
     so no index entry references it."""
+
+
+class StaleWriterError(RepoError):
+    """This writer was fenced by a peer's stale-lock takeover; its index
+    and snapshot publishes are refused (the fence-first recycle order
+    from cluster/sessions.py applied to repository writers)."""
+
+
+class _IndexReloadRace(RuntimeError):
+    """A load_index pass raced a concurrent consolidation (delta
+    deleted mid-scan) or a torn delta PUT; the whole pass restarts
+    (classified retryable by the reload policy)."""
 
 
 # Shared worker pools for the pipelined write path — module-level
@@ -231,6 +254,33 @@ class Repository:
         # without editing code; the class attribute stays as the
         # documented default for direct patching in tests.
         self.LOCK_STALE_SECONDS = envflags.lock_stale_seconds()
+        # -- multi-writer protocol state (docs/robustness.md) --
+        # Every Repository instance is one "writer": a fresh random id
+        # stamped into its lock objects and index-delta keys, plus the
+        # fencing generation observed at open/takeover. A peer that
+        # takes over this writer's stale lock marks fenced/<writer-id>
+        # first; _guard_publish then refuses every later publish.
+        import os
+
+        self.writer_id = os.urandom(8).hex()
+        self.generation = 0
+        # Marker puts (gen/ stamps, takeover/ claims, fenced/ flags)
+        # need their own retry budget: ResilientStore deliberately does
+        # NOT retry put_if_absent (see _claim_marker for why it is safe
+        # here), so without this a single transient transport fault
+        # would kill open() or a takeover mid-protocol.
+        self._marker_policy = RetryPolicy.from_env(
+            "repo.fence_marker", max_attempts=6, base_delay=0.02,
+            max_delay=0.25)
+        #: packs parked in pending-delete/ manifests: dedup treats
+        #: entries pointing at them as ABSENT, so new backups re-store
+        #: those blobs instead of extending a marked pack's life.
+        self._pending_packs: set[str] = set()
+        #: index-delta keys this writer published (prune must know its
+        #: own mid-run deltas to supersede them at consolidation)
+        self._published_deltas: list[str] = []
+        #: store keys of lock objects this instance currently holds
+        self._held_locks: set[str] = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -268,7 +318,9 @@ class Repository:
         # config-clobber race for a store that forgot to implement it).
         if not store.put_if_absent("config", payload):
             raise RepoError("repository already initialized")
-        return cls(store, box, config)
+        repo = cls(store, box, config)
+        repo._bump_generation()
+        return repo
 
     @classmethod
     def open(cls, store: ObjectStore,
@@ -290,6 +342,7 @@ class Repository:
         else:
             box = crypto.PlainBox()
         repo = cls(store, box, config)
+        repo._bump_generation()  # every open mints a writer generation
         repo.load_index()
         return repo
 
@@ -299,41 +352,125 @@ class Repository:
 
     # -- locking ------------------------------------------------------------
     #
-    # restic-style lock objects in the store (locks/<id>): writers take a
-    # shared lock, prune/forget take an exclusive lock, so a concurrent
-    # prune can never sweep a live backup's freshly written packs/index
-    # deltas. Create-then-check (restic's own protocol): write our lock
-    # object first, then scan for conflicts; back out on conflict. Locks
-    # older than LOCK_STALE_SECONDS are treated as crashed holders and
-    # removed; live holders refresh their lock's timestamp every
-    # LOCK_REFRESH_SECONDS (restic's ~5-minute refresh) so a long-running
-    # backup is never mistaken for a crash.
+    # restic-style lock objects in the store (locks/<id>). Modes:
+    # "shared" (backup/restore writers), "prune" (two-phase prune and
+    # repair — coexists with shared writers, conflicts with other
+    # pruners), "exclusive" (forget, stop-the-world prune).
+    # Create-then-check (restic's own protocol): write our lock object
+    # first, then scan for conflicts; back out on conflict. Locks older
+    # than LOCK_STALE_SECONDS are crashed holders: their removal is
+    # arbitrated by an atomic put_if_absent takeover marker
+    # (takeover/<lock-id>) so two observers can never both "win", and
+    # the winner fences the victim writer (fenced/<writer-id>) and
+    # bumps the generation BEFORE deleting the lock — a holder that was
+    # merely slow, not dead, finds its later index/snapshot publishes
+    # refused by _guard_publish instead of silently corrupting the
+    # repo. Live holders refresh their lock's "time" every
+    # LOCK_REFRESH_SECONDS (restic's ~5-minute refresh); "created" is
+    # immutable and orders the lock against pending-delete manifests
+    # for the sweep decision.
 
     LOCK_STALE_SECONDS = 30 * 60
     LOCK_REFRESH_SECONDS = 5 * 60
+
+    #: lock mode -> the set of peer modes it cannot coexist with
+    _LOCK_CONFLICTS = {
+        "shared": frozenset({"exclusive"}),
+        "prune": frozenset({"prune", "exclusive"}),
+        "exclusive": frozenset({"shared", "prune", "exclusive"}),
+    }
 
     #: Default contention wait for lock() callers that don't pass one
     #: (movers raise it so a shared/exclusive collision between two CRs
     #: waits out the other side instead of failing the whole sync).
     default_lock_wait: float = 0.0
 
-    def _write_lock(self, exclusive: bool) -> str:
+    def _write_lock(self, mode) -> str:
         import os
         import socket
 
+        if isinstance(mode, bool):  # historical exclusive-flag spelling
+            mode = "exclusive" if mode else "shared"
+        now = datetime.now(timezone.utc).isoformat()
         payload = json.dumps({
-            "exclusive": exclusive,
+            "exclusive": mode == "exclusive",  # read by older peers
+            "mode": mode,
+            "writer": self.writer_id,
+            "gen": self.generation,
             "hostname": socket.gethostname(),
             "pid": os.getpid(),
-            "time": datetime.now(timezone.utc).isoformat(),
+            "time": now,      # refreshed every LOCK_REFRESH_SECONDS
+            "created": now,   # immutable: orders the lock vs manifests
         }).encode()
         lock_id = hashlib.sha256(payload + os.urandom(16)).hexdigest()
         self.store.put(f"locks/{lock_id}", payload)
         return f"locks/{lock_id}"
 
-    def _conflicting_lock(self, own_key: str,
-                          exclusive: bool) -> Optional[str]:
+    @staticmethod
+    def _lock_mode(info: dict) -> str:
+        return info.get(
+            "mode", "exclusive" if info.get("exclusive") else "shared")
+
+    def _take_over_stale_lock(self, key: str, info: dict) -> bool:
+        """Atomically claim removal of one stale lock. Returns True if
+        WE won the takeover (victim fenced, lock removed, generation
+        bumped); False if a peer holds the claim — the caller must then
+        treat the lock as still conflicting and re-poll, never delete
+        it itself (the double-takeover race this marker closes)."""
+        lock_id = key.split("/", 1)[1]
+        marker_key = f"takeover/{lock_id}"
         now = datetime.now(timezone.utc)
+        marker = json.dumps({"writer": self.writer_id,
+                             "time": now.isoformat()}).encode()
+        if not self._claim_marker(marker_key, marker):
+            # A peer claimed this takeover first — unless the "peer" is
+            # our own ambiguous first attempt (a retried put_if_absent
+            # observing the marker it landed): the claim names its
+            # writer, so read it back before conceding. If a real peer
+            # claimed and then crashed, its marker outlives the
+            # horizon: expire the claim so the NEXT poll can retry —
+            # but never proceed past the lock now.
+            try:
+                prior = json.loads(self.store.get(marker_key))
+                age = (now - _parse_time(prior["time"])).total_seconds()
+            except (NoSuchKey, ValueError, KeyError):
+                return False  # marker vanished/torn: repoll decides
+            if prior.get("writer") != self.writer_id:
+                if age > self.LOCK_STALE_SECONDS:
+                    self.store.delete(marker_key)
+                return False
+        # We hold the claim — but the lock list we acted on may be
+        # stale: a peer can have completed this takeover (lock deleted,
+        # marker cleaned) between our listing and our claim, making the
+        # marker free to win again. Re-verify the lock still exists
+        # before fencing; if it is gone the takeover already happened,
+        # so back out without double-fencing or double-counting.
+        if not self.store.exists(key):
+            self.store.delete(marker_key)
+            return False
+        # Fence FIRST (cluster/sessions.py recycle order): by the time
+        # the victim could observe its lock missing, its publishes are
+        # already refused. Reclaiming one's OWN stale lock (a stalled
+        # but living writer) must not self-fence — same process, no
+        # split brain to guard against.
+        victim = info.get("writer", "")
+        if victim and victim != self.writer_id:
+            self._claim_marker(
+                f"fenced/{victim}",
+                json.dumps({"by": self.writer_id, "lock": lock_id,
+                            "time": now.isoformat()}).encode())
+        self.store.delete(key)
+        self.store.delete(marker_key)
+        self._bump_generation()
+        GLOBAL_METRICS.repo_takeovers_total.inc()
+        record_trigger("repo_takeover", lock=lock_id,
+                       victim_writer=victim,
+                       new_generation=str(self.generation))
+        return True
+
+    def _conflicting_lock(self, own_key: str, mode: str) -> Optional[str]:
+        now = datetime.now(timezone.utc)
+        conflicts = self._LOCK_CONFLICTS[mode]
         for key in list(self.store.list("locks/")):
             if key == own_key:
                 continue
@@ -346,9 +483,12 @@ class Repository:
             except (KeyError, ValueError):
                 age = self.LOCK_STALE_SECONDS + 1
             if age > self.LOCK_STALE_SECONDS:
-                self.store.delete(key)  # crashed holder
-                continue
-            if exclusive or info.get("exclusive"):
+                if self._take_over_stale_lock(key, info):
+                    continue  # crashed holder removed (by us)
+                # A peer owns the takeover and may still be mid-
+                # removal: re-poll rather than race its delete.
+                return key
+            if self._lock_mode(info) in conflicts:
                 # Make the wait observable: a waiter stalled behind a
                 # dying holder shows as this gauge climbing toward
                 # LOCK_STALE_SECONDS instead of a silent stall.
@@ -358,15 +498,25 @@ class Repository:
 
     @contextmanager
     def lock(self, *, exclusive: bool = False,
+             mode: Optional[str] = None,
              wait_seconds: Optional[float] = None):
         """Hold a repository lock for the duration of the with-block.
+
+        ``mode`` is "shared", "prune", or "exclusive"; the boolean
+        ``exclusive`` kwarg is the historical spelling of
+        shared/exclusive. Shared holders coexist with each other and
+        with one prune-mode holder; "exclusive" excludes everything.
 
         Raises RepoLockedError if a conflicting lock persists past
         ``wait_seconds`` (default: ``self.default_lock_wait``).
         """
+        if mode is None:
+            mode = "exclusive" if exclusive else "shared"
+        if mode not in self._LOCK_CONFLICTS:
+            raise ValueError(f"unknown lock mode {mode!r}")
         if wait_seconds is None:
             wait_seconds = self.default_lock_wait
-        own: Optional[str] = self._write_lock(exclusive)
+        own: Optional[str] = self._write_lock(mode)
         stop = threading.Event()
         refresher = None
         try:
@@ -382,7 +532,7 @@ class Repository:
                 "repo.lock_contend", base_delay=0.2 * cap,
                 max_delay=cap).backoffs()
             while True:
-                conflict = self._conflicting_lock(own, exclusive)
+                conflict = self._conflicting_lock(own, mode)
                 if conflict is None:
                     break
                 # Back out before waiting (restic's protocol): keeping our
@@ -393,11 +543,12 @@ class Repository:
                 if time_mod.monotonic() >= deadline:
                     raise RepoLockedError(
                         f"repository is locked by {conflict} "
-                        f"(wanted {'exclusive' if exclusive else 'shared'})")
+                        f"(wanted {mode})")
                 time_mod.sleep(next(contend_delays))
-                own = self._write_lock(exclusive)
+                own = self._write_lock(mode)
 
             lock_key = own
+            self._held_locks.add(lock_key)
 
             refresh_policy = RetryPolicy.from_env(
                 "repo.lock_refresh", max_attempts=2, base_delay=0.05,
@@ -439,6 +590,8 @@ class Repository:
             yield
         finally:
             stop.set()
+            if own is not None:
+                self._held_locks.discard(own)
             if refresher is not None:
                 # The refresher deletes the lock when it exits; the join
                 # just bounds how long release waits for that.
@@ -449,33 +602,95 @@ class Repository:
                 except NoSuchKey:
                     pass
 
+    # -- writer generations / fencing ---------------------------------------
+
+    def _claim_marker(self, key: str, payload: bytes) -> bool:
+        """put_if_absent with retries. The blanket no-retry rule for
+        put_if_absent (resilience.py _RETRIED_OPS) exists because a
+        retry can observe its OWN ambiguous first attempt as "exists";
+        for the protocol markers this helper writes that misread is
+        safe: gen/ stamps just mint the next number, takeover/ claims
+        carry the claimant's writer id and are re-read on a False (see
+        _take_over_stale_lock), and a fenced/ flag is idempotent — any
+        claimant writing it yields the same outcome."""
+        return self._marker_policy.call(
+            self.store.put_if_absent, key, payload)
+
+    def _load_generation(self) -> int:
+        gen = 0
+        for key in self.store.list("gen/"):
+            try:
+                gen = max(gen, int(key.split("/", 1)[1]))
+            except ValueError:
+                continue  # foreign junk under gen/ never wedges open
+        return gen
+
+    def _bump_generation(self) -> int:
+        """Mint a strictly newer generation stamp. The put_if_absent
+        loop gives concurrent minters distinct numbers; stamps are tiny
+        and repair() trims superseded ones."""
+        n = self._load_generation()
+        while True:
+            n += 1
+            if self._claim_marker(f"gen/{n:012d}", b"{}"):
+                break
+        self.generation = max(self.generation, n)
+        GLOBAL_METRICS.repo_writer_generation.set(self.generation)
+        return n
+
+    def _guard_publish(self, what: str) -> None:
+        """guard(gen): refuse a fenced writer's late publish. A peer
+        that takes over this writer's stale lock marks
+        fenced/<writer-id> BEFORE touching anything else (fence-first),
+        so by the time the zombie reaches its next publish the marker
+        is durable. Raises StaleWriterError; the refusal is counted and
+        flight-recorded."""
+        if not self.store.exists(f"fenced/{self.writer_id}"):
+            return
+        GLOBAL_METRICS.repo_fenced_publishes_total.inc()
+        record_trigger("repo_fenced_publish", writer=self.writer_id,
+                       generation=str(self.generation), what=what)
+        raise StaleWriterError(
+            f"writer {self.writer_id} (generation {self.generation}) "
+            f"was fenced by a stale-lock takeover; {what} refused")
+
     # -- index --------------------------------------------------------------
 
     def load_index(self):
         """(Re)read index deltas from the store.
 
-        Entries for blobs this process has written but not yet persisted
-        to an index object — the open pack's buffer and _pending_index —
-        are preserved: a mid-lifecycle reload (backup/restore re-reading
-        after lock acquisition) must not wipe a concurrent local writer's
-        in-flight state.
+        Read-snapshot semantics: one pass over ``index/`` builds a
+        FRESH index that is swapped in atomically under repo.state — a
+        failed reload never leaves a half-loaded index behind (callers
+        keep the previous snapshot). A delta deleted mid-scan (a
+        concurrent prune consolidating) restarts the whole pass against
+        the new delta set; a torn delta body (a concurrent writer's PUT
+        still landing or retrying) is re-fetched once and the pass
+        restarts if it stays undecodable — so a reload racing a
+        concurrent writer sees either none of that writer's delta or
+        all of it, never half. Entries for blobs this process has
+        written but not yet persisted to an index object — the open
+        pack's buffer, _pending_index, and the pipelined in-flight
+        queues — are re-inserted after the swap: a mid-lifecycle reload
+        (backup/restore re-reading after lock acquisition) must not
+        wipe a concurrent local writer's in-flight state. Also
+        refreshes the pending-delete pack set (the dedup exclusion) and
+        the fencing generation.
         """
-        with self._lock:  # lint: ignore[VL101] — load_index runs before
-            # any pipeline thread exists (open/refresh paths); holding
-            # repo.state across the index GETs is what makes the reload
-            # atomic w.r.t. a concurrent local writer's in-flight state
-            self._index.clear()
-            # Streaming: one index delta decoded at a time; entries land
-            # in the flat compact index, never in per-entry objects.
-            for key in self.store.list("index/"):
-                payload = json.loads(
-                    self._zd.decompress(self.box.open(self.store.get(key)))
-                )  # under self._lock; _zd is per-thread anyway
-                for pack_id, entries in payload["packs"].items():
-                    for e in entries:
-                        self._index.insert(
-                            e["id"], pack_id, e["type"], e["offset"],
-                            e["length"], e["raw_length"])
+        with self._lock:  # lint: ignore[VL101] — reviewed: holding
+            # repo.state across the index GETs is what makes the
+            # swap + in-flight re-insert atomic w.r.t. a concurrent
+            # local writer; pool workers never take this lock.
+            reload_policy = RetryPolicy.from_env(
+                "repo.index_reload", max_attempts=4, base_delay=0.02,
+                max_delay=0.5, retryable=(_IndexReloadRace,))
+            fresh, pending = reload_policy.call(self._read_index_snapshot)
+            self._index = fresh
+            self._pending_packs = pending
+            GLOBAL_METRICS.repo_pending_delete_packs.set(len(pending))
+            self.generation = max(self.generation,
+                                  self._load_generation())
+            GLOBAL_METRICS.repo_writer_generation.set(self.generation)
             for pack_id, entries in self._pending_index.items():
                 for e in entries:
                     self._index.insert(
@@ -498,9 +713,82 @@ class Repository:
                     ob.meta["id"], "", ob.meta["type"], 0, 0,
                     ob.meta["raw_length"], replace=False)
 
+    def _decode_index_delta(self, raw: bytes) -> dict:
+        return json.loads(self._zd.decompress(self.box.open(raw)))
+
+    def _read_index_snapshot(self) -> tuple[ShardedBlobIndex, set]:
+        """One full pass over ``index/`` + ``pending-delete/`` into a
+        fresh index (load_index holds repo.state and swaps it in).
+        Raises _IndexReloadRace when the pass must restart."""
+        from volsync_tpu.repo.compress import CompressError
+
+        fresh = ShardedBlobIndex()
+        # Pending set FIRST: a blob listed by several deltas (a crashed
+        # pruner's old delta parks it in a marked pack, the consolidated
+        # shard repoints it) must resolve to the non-pending home —
+        # pending-pack entries never overwrite an existing entry below.
+        pending: set[str] = set()
+        for _key, man in self._load_pending_manifests():
+            pending.update(man.get("packs", ()))
+        # Streaming: one index delta decoded at a time; entries land
+        # in the flat compact index, never in per-entry objects.
+        for key in list(self.store.list("index/")):
+            try:
+                raw = self.store.get(key)
+            except NoSuchKey:
+                raise _IndexReloadRace(
+                    f"index delta {key} consolidated mid-scan") from None
+            try:
+                payload = self._decode_index_delta(raw)
+            except (ValueError, CompressError):
+                # Torn body: the writer's PUT may still be retrying
+                # (a torn write leaves a truncated object the retry
+                # overwrites). One re-fetch, then restart the pass.
+                try:
+                    payload = self._decode_index_delta(
+                        self.store.get(key))
+                except NoSuchKey:
+                    raise _IndexReloadRace(
+                        f"index delta {key} consolidated mid-scan"
+                    ) from None
+                except (ValueError, CompressError) as ex:
+                    raise _IndexReloadRace(
+                        f"index delta {key} stayed undecodable: {ex}"
+                    ) from ex
+            for pack_id, entries in payload["packs"].items():
+                replace = pack_id not in pending
+                for e in entries:
+                    fresh.insert(e["id"], pack_id, e["type"],
+                                 e["offset"], e["length"],
+                                 e["raw_length"], replace=replace)
+        return fresh, pending
+
+    def _load_pending_manifests(self) -> list[tuple[str, dict]]:
+        """``[(key, manifest)]`` under ``pending-delete/``, skipping
+        objects a crashed pruner left torn (a retried prune re-marks
+        the same victims, so skipping loses nothing durable)."""
+        out: list[tuple[str, dict]] = []
+        for key in list(self.store.list("pending-delete/")):
+            try:
+                man = json.loads(self.store.get(key))
+            except (NoSuchKey, ValueError):
+                continue  # swept mid-scan, or torn by a crashed pruner
+            out.append((key, man))
+        return out
+
     def has_blob(self, blob_id: str) -> bool:
         with self._lock:
+            return self._present_for_dedup(blob_id)
+
+    def _present_for_dedup(self, blob_id: str) -> bool:
+        """Present, and NOT parked in a pending-delete pack. New
+        backups must re-store blobs whose only copy lives in a marked
+        pack (repointing the entry at the new pack) instead of
+        extending the marked pack's life past its sweep deadline."""
+        if not self._pending_packs:
             return blob_id in self._index
+        tup = self._index.lookup(blob_id)
+        return tup is not None and tup[0] not in self._pending_packs
 
     def has_blobs(self, blob_ids) -> "np.ndarray":
         """Vectorized dedup membership for a whole chunk batch ->
@@ -510,9 +798,17 @@ class Repository:
         synchronizes per shard, so concurrent backups query in
         parallel. A query racing load_index()/a writer may miss the
         newest entries — dedup is advisory, so the worst case is one
-        duplicate blob stored, never a wrong restore."""
+        duplicate blob stored, never a wrong restore. Entries pointing
+        at pending-delete packs count as absent (_present_for_dedup)."""
         with span("repo.dedup_query"):
-            return self._index.contains_many(blob_ids)
+            blob_ids = list(blob_ids)
+            mask = self._index.contains_many(blob_ids)
+            pending = self._pending_packs
+            if pending and mask.any():
+                for i, tup in enumerate(self._index.lookup_many(blob_ids)):
+                    if tup is not None and tup[0] in pending:
+                        mask[i] = False
+            return mask
 
     def blob_ids(self) -> set:
         with self._lock:
@@ -569,7 +865,7 @@ class Repository:
             # that is the serial fallback and the bounded-backpressure
             # design (docs/performance.md). Pool workers never take
             # this lock, so the puts cannot deadlock, only serialize.
-            if blob_id in self._index:
+            if self._present_for_dedup(blob_id):
                 if stats:
                     stats.blobs_dedup += 1
                     stats.bytes_dedup += len(data)
@@ -597,8 +893,13 @@ class Repository:
             # fallback/backpressure store puts as add_blob (above);
             # pool workers never take repo.state.
             with span("repo.dedup_query"):
-                present = self._index.contains_many(
-                    [blob_id for blob_id, _ in blobs])
+                ids = [blob_id for blob_id, _ in blobs]
+                present = self._index.contains_many(ids)
+                if self._pending_packs and present.any():
+                    for i, tup in enumerate(self._index.lookup_many(ids)):
+                        if (tup is not None
+                                and tup[0] in self._pending_packs):
+                            present[i] = False
             seen: set = set()
             for (blob_id, data), have in zip(blobs, present):
                 if have or blob_id in seen:
@@ -762,7 +1063,8 @@ class Repository:
                 continue
             for e in pk.entries:
                 cur = self._index.lookup(e["id"])
-                if cur is None or cur[0] == "":
+                if (cur is None or cur[0] == ""
+                        or cur[0] in self._pending_packs):
                     self._index.insert(e["id"], pack_id, e["type"],
                                        e["offset"], e["length"],
                                        e["raw_length"])
@@ -813,9 +1115,11 @@ class Repository:
             self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
         for e in self._cur_entries:
             cur = self._index.lookup(e["id"])
-            if cur is None or cur[0] == "":
+            if (cur is None or cur[0] == ""
+                    or cur[0] in self._pending_packs):
                 # bind the buffered entry to its now-durable pack (or
-                # re-add if a load_index dropped it — always safe)
+                # re-add if a load_index dropped it — always safe; a
+                # pending-delete pack's entry repoints here too)
                 self._index.insert(e["id"], pack_id, e["type"], e["offset"],
                                    e["length"], e["raw_length"])
             # else: rebound to a store-sourced pack by load_index — its
@@ -827,7 +1131,13 @@ class Repository:
             self._persist_pending()
 
     def _persist_pending(self):
-        """Write buffered index entries as one index delta object."""
+        """Write buffered index entries as one index delta object under
+        the per-writer key ``index/<gen>-<writer>-<hash>`` — writers
+        never contend on a shared index object, and a pruner can tell
+        its own mid-run deltas apart from concurrent writers' (which it
+        must preserve). Fenced writers are refused (_guard_publish),
+        including a fence that lands while the put is in flight — the
+        zombie's delta is withdrawn before the error surfaces."""
         lockcheck.assert_held(self._lock,
                               "pending index buffer (_pending_index)")
         if not self._pending_index:
@@ -835,8 +1145,17 @@ class Repository:
         payload = self.box.seal(self._zc.compress(json.dumps(
             {"packs": self._pending_index}
         ).encode()))
-        idx_id = hashlib.sha256(payload).hexdigest()
-        self.store.put(f"index/{idx_id}", payload)
+        digest = hashlib.sha256(payload).hexdigest()
+        key = (f"index/{self.generation:012d}-{self.writer_id}"
+               f"-{digest[:32]}")
+        self._guard_publish("index delta")
+        self.store.put(key, payload)
+        try:
+            self._guard_publish("index delta")
+        except StaleWriterError:
+            self.store.delete(key)  # fenced mid-put: withdraw it
+            raise
+        self._published_deltas.append(key)
         self._pending_index = {}
         self._pending_count = 0
 
@@ -923,7 +1242,13 @@ class Repository:
         manifest.setdefault("time", datetime.now(timezone.utc).isoformat())
         payload = self.box.seal(json.dumps(manifest).encode())
         snap_id = hashlib.sha256(payload).hexdigest()
+        self._guard_publish("snapshot publish")
         self.store.put(f"snapshots/{snap_id}", payload)
+        try:
+            self._guard_publish("snapshot publish")
+        except StaleWriterError:
+            self.store.delete(f"snapshots/{snap_id}")  # fenced mid-put
+            raise
         return snap_id
 
     def list_snapshots(self) -> list[tuple[str, dict]]:
@@ -1047,140 +1372,504 @@ class Repository:
             return np.empty((0,), dtype="S32")
         return np.unique(np.frombuffer(bytes(ids), dtype="S32"))
 
-    def prune(self) -> dict:
-        """Drop unreferenced blobs by rewriting partially-live packs
-        (restic ``prune`` — cadence governed by the mover's
+    def _resolve_grace(self, grace_seconds: Optional[float]) -> float:
+        """Precedence: explicit argument, VOLSYNC_PRUNE_GRACE_S, then
+        the lock-staleness horizon — the smallest deadline guaranteeing
+        any writer still able to dedup against a victim pack either
+        shows a live lock (blocking the sweep) or is stale enough that
+        its takeover fenced it."""
+        if grace_seconds is not None:
+            return max(0.0, float(grace_seconds))
+        env = envflags.prune_grace_seconds()
+        if env is not None:
+            return env
+        return float(self.LOCK_STALE_SECONDS)
+
+    def _live_foreign_locks(self) -> list[dict]:
+        """Decoded payloads of every live lock held by OTHER Repository
+        instances (stale, torn, and own locks skipped). Each payload
+        carries ``_created``: the holder's immutable acquisition time,
+        which the sweep gate compares against manifest mark times."""
+        now = datetime.now(timezone.utc)
+        locks: list[dict] = []
+        for key in list(self.store.list("locks/")):
+            if key in self._held_locks:
+                continue
+            try:
+                info = json.loads(self.store.get(key))
+            except (NoSuchKey, ValueError):
+                continue  # released or torn mid-read: not a live holder
+            try:
+                age = (now - _parse_time(info["time"])).total_seconds()
+            except (KeyError, ValueError):
+                continue  # undecodable age: the stale-lock poll owns it
+            if age > self.LOCK_STALE_SECONDS:
+                continue
+            try:
+                info["_created"] = _parse_time(
+                    info.get("created", info["time"]))
+            except ValueError:
+                info["_created"] = now  # conservative: blocks the sweep
+            locks.append(info)
+        return locks
+
+    def _sweep_blocked(self, marked_at: datetime,
+                       locks: list[dict]) -> bool:
+        """A live foreign lock acquired before (or skew-close to) a
+        manifest's mark time may belong to a writer that loaded its
+        index BEFORE the marked packs were excluded from dedup — its
+        in-flight backup may still reference them, so the sweep must
+        wait. Writers that locked after the mark saw the manifest at
+        load_index and never dedup into marked packs, which is what
+        makes this gate sufficient. LOCK_REFRESH_SECONDS of slack
+        absorbs clock skew between the pruner's mark stamp and the
+        holders' acquisition stamps."""
+        horizon = marked_at + timedelta(seconds=self.LOCK_REFRESH_SECONDS)
+        return any(info["_created"] <= horizon for info in locks)
+
+    def _write_pending_manifest(self, packs: set, grace: float) -> str:
+        """Park victim packs under ``pending-delete/``. Plaintext JSON:
+        repair tooling and foreign writers must read the manifest during
+        load_index without first proving they hold the repo key for THIS
+        object (the pack ids it names are already visible in ``data/``
+        listings, so nothing secret leaks)."""
+        now = datetime.now(timezone.utc)
+        manifest = {
+            "packs": sorted(packs),
+            "marked_at": now.isoformat(),
+            "deadline": (now + timedelta(seconds=grace)).isoformat(),
+            "gen": self.generation,
+            "writer": self.writer_id,
+        }
+        payload = json.dumps(manifest).encode()
+        key = "pending-delete/" + hashlib.sha256(payload).hexdigest()[:32]
+        self._guard_publish("pending-delete manifest")
+        self.store.put(key, payload)
+        return key
+
+    def _write_consolidated_index(self) -> set[str]:
+        """Write the whole in-memory index as bounded shard objects
+        (~PENDING_INDEX_LIMIT entries each) under this writer's
+        gen-writer key prefix; returns the new shard keys. No single
+        index object — or its in-memory JSON — scales with the whole
+        repository."""
+        new_keys: set[str] = set()
+        shard: dict[str, list[dict]] = {}
+        count = 0
+
+        def emit_shard():
+            nonlocal shard, count
+            if not shard:
+                return
+            payload = self.box.seal(self._zc.compress(
+                json.dumps({"packs": shard}).encode()))
+            digest = hashlib.sha256(payload).hexdigest()
+            key = (f"index/{self.generation:012d}-{self.writer_id}"
+                   f"-{digest[:32]}")
+            self._guard_publish("consolidated index shard")
+            self.store.put(key, payload)
+            new_keys.add(key)
+            shard = {}
+            count = 0
+
+        for blob_id, (pack, btype, offset, length, raw) in \
+                self._index.items():
+            shard.setdefault(pack, []).append({
+                "id": blob_id, "type": btype, "offset": offset,
+                "length": length, "raw_length": raw,
+            })
+            count += 1
+            if count >= self.PENDING_INDEX_LIMIT:
+                emit_shard()
+        emit_shard()
+        return new_keys
+
+    def prune(self, *, grace_seconds: Optional[float] = None) -> dict:
+        """Two-phase mark-then-sweep GC that runs CONCURRENTLY with
+        backups (restic ``prune`` — cadence governed by the mover's
         prune_interval_days, SURVEY.md §2 #12).
+
+        The mark phase runs under a ``prune``-mode lock that admits
+        concurrent shared (backup/restore) holders: live blobs of
+        partially-live packs are rewritten into fresh packs, the victim
+        packs are parked in a ``pending-delete/`` manifest stamped with
+        a grace deadline, and the consolidated index is republished.
+        Victim packs stay in the store AND their dead entries stay in
+        the index until the sweep — dedup treats them as absent (see
+        ``_present_for_dedup``), but a writer that deduped against one
+        BEFORE the mark still restores through it. The sweep (the head
+        of every later prune) deletes only packs whose deadline expired
+        AND that no live foreign lock acquired before the mark could
+        still reference; reachable blobs still homed in a sweeping pack
+        are rescued into fresh packs first.
+
+        ``grace_seconds`` (or VOLSYNC_PRUNE_GRACE_S) overrides the
+        grace; the default is the lock-staleness horizon. ``0`` selects
+        the classic stop-the-world prune: an EXCLUSIVE lock, victims
+        swept in the same call, no manifest.
 
         Crash-safety ordering — data is never deleted before its
         replacement is durable:
-          1. rewrite live blobs of partially-live packs into new packs
-             and FLUSH them;
-          2. write the consolidated index;
-          3. delete superseded index deltas;
-          4. sweep pack objects not referenced by the new index (this
-             also collects orphans left by a crash in an earlier prune).
-        A crash between any steps leaves a repository where every
-        snapshot still restores. Takes an exclusive repository lock so a
-        concurrent backup's packs/index deltas are never swept.
+          1. rewrite live/rescued blobs into new packs and FLUSH them;
+          2. write the pending-delete manifest for this round's victims;
+          3. write the consolidated index shards;
+          4. delete superseded index deltas;
+          5. sweep expired packs, then their manifests.
+        A crash between any two steps leaves a repository where every
+        snapshot restores byte-identically and ``check(read_data=True)``
+        passes, and a retried prune completes the interrupted phase
+        (tests/test_crash_recovery.py proves each boundary).
         """
+        grace = self._resolve_grace(grace_seconds)
+        mode = "exclusive" if grace <= 0 else "prune"
+        # reviewed: prune holds repo.state across rewrite/sweep store
+        # I/O BY DESIGN — the crash-safety ordering above depends on no
+        # concurrent LOCAL writer mutating the index between steps.
+        # Remote writers are handled by the protocol itself: the
+        # prune-mode store lock excludes other pruners, and the
+        # manifest + grace + live-lock sweep gate protects concurrent
+        # backups (grace 0 falls back to a genuinely exclusive lock).
+        # lint: ignore[VL101]
+        with self.lock(mode=mode), self._lock:
+            return self._prune_locked(grace)
+
+    def _prune_locked(self, grace: float) -> dict:
         import numpy as np
 
-        # reviewed: prune is a stop-the-world maintenance pass; it
-        # holds repo.state across rewrite/sweep store I/O BY DESIGN
-        # (the crash-safety ordering above depends on no concurrent
-        # local writer mutating the index between steps). Nothing else
-        # can make progress anyway — the exclusive store-level lock in
-        # the same with-header fences out peers.
-        # lint: ignore[VL101]
-        with self.lock(exclusive=True), self._lock:
-            self.flush()
-            reach = self._referenced_keys()
-            # Whole-index liveness in vectorized passes: membership via
-            # one batched searchsorted over raw 32-byte keys, per-pack
-            # totals via bincount — no per-blob Python probes, no id
-            # materialization outside the dirty packs.
-            keys, pack_codes, pack_names = self._index.snapshot_arrays()
-            if reach.size and keys.size:
-                pos = np.clip(np.searchsorted(reach, keys), 0,
-                              reach.size - 1)
-                live_mask = reach[pos] == keys
-            else:
-                live_mask = np.zeros((keys.size,), dtype=bool)
-            totals = np.bincount(pack_codes, minlength=len(pack_names))
-            lives = np.bincount(pack_codes[live_mask],
-                                minlength=len(pack_names))
-            dirty_codes = np.nonzero(lives < totals)[0]
-            removed_blobs = 0
-            rewritten = 0
-            # Per-dirty-pack work lists; ids decode to hex only here.
-            # Extraction goes through a u8 row view: S-dtype scalar
-            # conversion strips trailing NUL bytes, which would truncate
-            # ~1/256 blob ids and crash the rewrite.
-            keys_u8 = keys.view(np.uint8).reshape(-1, 32)
-            order = np.argsort(pack_codes, kind="stable")
-            sorted_codes = pack_codes[order]
-            work: dict[str, list[str]] = {}
-            doomed: list[str] = []
-            for code in dirty_codes:
-                lo = np.searchsorted(sorted_codes, code, "left")
-                hi = np.searchsorted(sorted_codes, code, "right")
-                rows = order[lo:hi]
-                live_ids = [keys_u8[r].tobytes().hex() for r in rows
-                            if live_mask[r]]
-                doomed.extend(keys_u8[r].tobytes().hex() for r in rows
-                              if not live_mask[r])
-                if live_ids:
-                    work[pack_names[code]] = live_ids
-            # Rewrite one pack at a time; its live blobs are read
-            # CONCURRENTLY via the lock-free reader (store IO + decrypt
-            # overlap — the same pool pattern as check(); read_blob
-            # itself would deadlock on self._lock, which prune holds),
-            # then re-added under the new pack generation. Peak
-            # buffering is one pack's live payload.
-            from concurrent.futures import ThreadPoolExecutor
+        lockcheck.assert_held(self._lock, "prune (repo.state)")
+        self.flush()
+        self.load_index()
+        # Every index object visible NOW is superseded by the
+        # consolidated shards written below; deltas concurrent writers
+        # publish AFTER this listing are preserved. Own deltas
+        # published mid-prune (the rewrite's add_blob calls can trip
+        # _persist_pending) are tracked via _published_deltas.
+        baseline_deltas = set(self.store.list("index/"))
+        own_mark = len(self._published_deltas)
+        reach = self._referenced_keys()
+        now = datetime.now(timezone.utc)
+        locks = self._live_foreign_locks()
+        # -- sweep triage: which prior manifests are collectable -------
+        still_pending: set[str] = set()
+        sweep_packs: set[str] = set()
+        sweep_keys: list[str] = []
+        for key, man in self._load_pending_manifests():
+            packs = set(man.get("packs", ()))
+            try:
+                deadline = _parse_time(man["deadline"])
+                marked_at = _parse_time(man["marked_at"])
+            except (KeyError, ValueError):
+                # Damaged manifest: with marked_at == now the gate
+                # blocks on ANY live foreign lock — it sweeps only
+                # when quiescent. Conservative but terminating.
+                deadline = marked_at = now
+            if grace > 0 and (now < deadline
+                              or self._sweep_blocked(marked_at, locks)):
+                still_pending |= packs
+                continue
+            sweep_keys.append(key)
+            sweep_packs |= packs
+        sweep_packs -= still_pending  # in ANY blocked manifest => stays
+        # -- liveness: one vectorized membership pass ------------------
+        # Membership via batched searchsorted over raw 32-byte keys,
+        # per-pack totals via bincount — no per-blob Python probes, no
+        # id materialization outside the dirty packs.
+        keys, pack_codes, pack_names = self._index.snapshot_arrays()
+        if reach.size and keys.size:
+            pos = np.clip(np.searchsorted(reach, keys), 0,
+                          reach.size - 1)
+            live_mask = reach[pos] == keys
+        else:
+            live_mask = np.zeros((keys.size,), dtype=bool)
+        totals = np.bincount(pack_codes, minlength=len(pack_names))
+        lives = np.bincount(pack_codes[live_mask],
+                            minlength=len(pack_names))
+        # Ids decode to hex only inside per-pack work lists, through a
+        # u8 row view: S-dtype scalar conversion strips trailing NUL
+        # bytes, which would truncate ~1/256 blob ids.
+        keys_u8 = keys.view(np.uint8).reshape(-1, 32)
+        order = np.argsort(pack_codes, kind="stable")
+        sorted_codes = pack_codes[order]
+        code_of = {name: c for c, name in enumerate(pack_names)}
 
-            with ThreadPoolExecutor(8) as pool:
-                for pack_id, live_ids in work.items():
-                    jobs = [(b, self._entry(b)) for b in live_ids]
-                    datas = list(pool.map(
-                        lambda j: self._read_packed(j[0], j[1]), jobs))
-                    for (blob_id, entry), data in zip(jobs, datas):
-                        self._index.remove(blob_id)
-                        self.add_blob(entry.type, blob_id, data)
-                    rewritten += 1
-            # fully-dead packs: nothing to rewrite, still swept
-            rewritten += len(dirty_codes) - len(work)
-            for blob_id in doomed:
+        def pack_rows(code):
+            lo = np.searchsorted(sorted_codes, code, "left")
+            hi = np.searchsorted(sorted_codes, code, "right")
+            return order[lo:hi]
+
+        pending_all = still_pending | sweep_packs
+        dirty_codes = [c for c in np.nonzero(lives < totals)[0]
+                       if pack_names[c]
+                       and pack_names[c] not in pending_all]
+        removed_blobs = 0
+        rewritten = 0
+        rescued = 0
+        work: dict[str, list[str]] = {}
+        doomed: dict[str, list[str]] = {}
+        new_victims: set[str] = set()
+        # Sweep-time rescue: a pack being swept THIS call may still
+        # home reachable blobs (a crashed pruner never republished the
+        # index, or a writer deduped against the pack before its mark).
+        # Rewrite those into fresh packs before the pack goes away.
+        for pack in sorted(sweep_packs):
+            code = code_of.get(pack)
+            if code is None:
+                continue  # no index entries left for this pack
+            rows = pack_rows(code)
+            live_ids = [keys_u8[r].tobytes().hex() for r in rows
+                        if live_mask[r]]
+            if live_ids:
+                work[pack] = live_ids
+                rescued += len(live_ids)
+            doomed[pack] = [keys_u8[r].tobytes().hex() for r in rows
+                            if not live_mask[r]]
+        # Partially-dead packs become this round's new victims: live
+        # blobs rewritten now, dead ENTRIES retained until the sweep (a
+        # concurrent writer that deduped against one needs the entry
+        # and the pack alive until its own snapshot is republishable).
+        for code in dirty_codes:
+            name = pack_names[code]
+            new_victims.add(name)
+            rows = pack_rows(code)
+            live_ids = [keys_u8[r].tobytes().hex() for r in rows
+                        if live_mask[r]]
+            if live_ids:
+                work[name] = live_ids
+            rewritten += 1
+        # Orphan packs (a crashed writer's un-indexed uploads): marked
+        # pending-delete too — the grace window is what distinguishes
+        # "crashed" from "a live writer whose delta is still in
+        # flight"; a live writer's delta lands long before the grace
+        # expires and the pack stops being an orphan.
+        indexed = {p for p in pack_names if p}
+        orphans: set[str] = set()
+        for key in list(self.store.list("data/")):
+            pid = key.rsplit("/", 1)[1]
+            if (pid not in indexed and pid not in pending_all
+                    and pid not in new_victims):
+                orphans.add(pid)
+        if orphans:
+            record_trigger("repo_orphan", packs=sorted(orphans),
+                           source="prune")
+            new_victims |= orphans
+        if grace <= 0:
+            # Stop-the-world mode (exclusive lock, no concurrent
+            # writers possible): no manifest, this round's victims are
+            # swept in the same call.
+            for pack in sorted(new_victims):
+                code = code_of.get(pack)
+                rows = pack_rows(code) if code is not None else []
+                doomed[pack] = [keys_u8[r].tobytes().hex()
+                                for r in rows if not live_mask[r]]
+            sweep_packs |= new_victims
+            new_victims = set()
+        # Step 1: rewrite live/rescued blobs. Reads go through the
+        # lock-free reader CONCURRENTLY (store IO + decrypt overlap —
+        # the same pool pattern as check(); read_blob itself would
+        # deadlock on self._lock, which prune holds), then re-add under
+        # the new pack generation. Peak buffering is one pack's live
+        # payload.
+        with ThreadPoolExecutor(8) as pool:
+            for pack_id, live_ids in work.items():
+                jobs = [(b, self._entry(b)) for b in live_ids]
+                datas = list(pool.map(
+                    lambda j: self._read_packed(j[0], j[1]), jobs))
+                for (blob_id, entry), data in zip(jobs, datas):
+                    self._index.remove(blob_id)
+                    self.add_blob(entry.type, blob_id, data)
+        self._flush_data()  # rewrites durable before anything deleted
+        # Step 2: manifest for the new victims (deferred-sweep mode).
+        if new_victims:
+            self._write_pending_manifest(new_victims, grace)
+        # Step 3: consolidated index — swept packs' dead entries drop,
+        # new victims' dead entries stay (see above).
+        for pack, dead_ids in doomed.items():
+            for blob_id in dead_ids:
                 self._index.remove(blob_id)
                 removed_blobs += 1
-            self._flush_data()  # step 1 durable before anything is deleted
-            self._index.vacuum()
-            # Step 2: consolidated index, SHARDED into bounded delta
-            # objects (~PENDING_INDEX_LIMIT entries each) so no single
-            # index object — or its in-memory JSON — scales with the
-            # whole repository.
-            new_keys: set[str] = set()
-            shard: dict[str, list[dict]] = {}
-            count = 0
+        self._index.vacuum()
+        # Resurrection guard: pack ids are content-addressed, so the
+        # rewrite (ours now, or any writer's since the mark) can
+        # regenerate a byte-identical pack under the SAME id as a sweep
+        # candidate — e.g. re-rescuing the blobs a crashed pruner
+        # already rewrote into a now-orphaned pack. A candidate the
+        # post-rewrite index still references is a live pack again:
+        # it must survive the sweep (its manifest may still be
+        # deleted — the index now owns the reference).
+        referenced_now = {p for p in self._index.live_packs() if p}
+        sweep_packs -= referenced_now
+        new_keys = self._write_consolidated_index()
+        # Step 4: drop superseded deltas — everything visible at entry
+        # plus own mid-prune deltas; deltas concurrent writers
+        # published since the baseline listing are preserved. Deletes
+        # are idempotent, so a crash-retry re-runs this safely.
+        superseded = (baseline_deltas
+                      | set(self._published_deltas[own_mark:])) - new_keys
+        for key in superseded:
+            self.store.delete(key)
+        # Step 5: sweep expired packs, then their manifests.
+        for pack in sorted(sweep_packs):
+            self.store.delete(f"data/{pack[:2]}/{pack}")
+        for key in sweep_keys:
+            self.store.delete(key)
+        self._pending_index = {}
+        self._pending_count = 0
+        self._published_deltas = list(new_keys)
+        self._pending_packs = still_pending | new_victims
+        GLOBAL_METRICS.repo_pending_delete_packs.set(
+            len(self._pending_packs))
+        return {"packs_rewritten": rewritten,
+                "blobs_removed": removed_blobs,
+                "snapshots": len(self.list_snapshots()),
+                "packs_pending": len(self._pending_packs),
+                "packs_swept": len(sweep_packs),
+                "blobs_rescued": rescued}
 
-            def emit_shard():
-                nonlocal shard, count
-                if not shard:
-                    return
-                payload = self.box.seal(self._zc.compress(
-                    json.dumps({"packs": shard}).encode()))
-                key = f"index/{hashlib.sha256(payload).hexdigest()}"
-                self.store.put(key, payload)
-                new_keys.add(key)
-                shard = {}
-                count = 0
+    # -- repair -------------------------------------------------------------
 
-            for blob_id, (pack, btype, offset, length, raw) in \
-                    self._index.items():
-                shard.setdefault(pack, []).append({
-                    "id": blob_id, "type": btype, "offset": offset,
-                    "length": length, "raw_length": raw,
-                })
-                count += 1
-                if count >= self.PENDING_INDEX_LIMIT:
-                    emit_shard()
-            emit_shard()
-            # Step 3: drop superseded deltas.
-            for key in list(self.store.list("index/")):
-                if key not in new_keys:
-                    self.store.delete(key)
-            # Step 4: sweep unreferenced pack objects.
-            live_packs = {f"data/{p[:2]}/{p}"
-                          for p in self._index.live_packs() if p}
-            for key in list(self.store.list("data/")):
-                if key not in live_packs:
-                    self.store.delete(key)
-            self._pending_index = {}
-            self._pending_count = 0
-            return {"packs_rewritten": rewritten,
-                    "blobs_removed": removed_blobs,
-                    "snapshots": len(self.list_snapshots())}
+    def _walk_trees_tolerant(self) -> tuple[set[str], list[str]]:
+        """Reachable blob ids (hex) via a tree walk that RECORDS broken
+        trees instead of raising — repair must survive exactly the
+        damage it exists to diagnose. Any broken tree makes the
+        reachable set a lower bound, so callers withhold destructive
+        resolution while the list is non-empty."""
+        reach: set[str] = set()
+        broken: list[str] = []
+        stack = [m["tree"] for _, m in self.list_snapshots()]
+        while stack:
+            tree_id = stack.pop()
+            if tree_id in reach:
+                continue
+            reach.add(tree_id)
+            try:
+                tree = json.loads(self.read_blob(tree_id))
+            except Exception as ex:  # noqa: BLE001 — report, don't die:
+                # the id lands in broken_trees, which blocks every
+                # destructive resolution step downstream.
+                broken.append(f"{tree_id}: {ex}")
+                continue
+            for entry in tree["entries"]:
+                if entry["type"] == "dir":
+                    stack.append(entry["subtree"])
+                elif entry["type"] == "file":
+                    reach.update(entry["content"])
+        return reach, broken
+
+    def repair(self, *, apply: bool = True,
+               grace_seconds: Optional[float] = None) -> dict:
+        """Detect and resolve the debris crashed writers and pruners
+        leave behind: orphaned packs (uploaded, never indexed), expired
+        pending-delete manifests, dangling index entries (their pack is
+        missing from the store), stale takeover/fence markers, and
+        superseded generation stamps.
+
+        ``apply=False`` (``volsync repair --dry-run``) scans and
+        reports without mutating. With ``apply=True``, dangling entries
+        whose blobs are UNREACHABLE are dropped and the index
+        consolidated; reachable ones are reported as
+        ``unrecoverable_blobs`` and left in place — repair never
+        deletes a referenced blob's last record. Stale markers and old
+        generation stamps are removed, and (when the scan found no
+        broken trees and no unrecoverable blobs) a full two-phase prune
+        pass runs, which marks orphans and sweeps expired manifests.
+
+        Runbook caveat (docs/robustness.md): deleting a stale
+        ``fenced/<writer>`` marker re-admits that writer id — only run
+        an applying repair when the fenced process is known dead.
+        """
+        grace = self._resolve_grace(grace_seconds)
+        mode = "exclusive" if grace <= 0 else "prune"
+        # reviewed: same rationale as prune — repair IS the maintenance
+        # pass; it holds repo.state across scan/resolve store I/O so no
+        # concurrent local writer mutates the index between steps, and
+        # the store-level lock + two-phase protocol handle peers.
+        # lint: ignore[VL101]
+        with self.lock(mode=mode), self._lock:
+            self.flush()
+            self.load_index()
+            now = datetime.now(timezone.utc)
+            with span("repo.repair.scan"):
+                reach_hex, broken_trees = self._walk_trees_tolerant()
+                store_packs = {key.rsplit("/", 1)[1]
+                               for key in self.store.list("data/")}
+                indexed = {p for p in self._index.live_packs() if p}
+                dangling_packs = sorted(indexed - store_packs)
+                orphan_packs = sorted(store_packs - indexed
+                                      - self._pending_packs)
+                manifests = self._load_pending_manifests()
+                expired = []
+                for key, man in manifests:
+                    try:
+                        deadline = _parse_time(man["deadline"])
+                    except (KeyError, ValueError):
+                        expired.append(key)
+                        continue
+                    if now >= deadline:
+                        expired.append(key)
+                stale_markers = []
+                for prefix in ("takeover/", "fenced/"):
+                    for key in list(self.store.list(prefix)):
+                        try:
+                            info = json.loads(self.store.get(key))
+                            age = (now - _parse_time(info["time"])
+                                   ).total_seconds()
+                        except (NoSuchKey, KeyError, ValueError):
+                            stale_markers.append(key)  # torn: debris
+                            continue
+                        if age > self.LOCK_STALE_SECONDS:
+                            stale_markers.append(key)
+                old_gens = sorted(self.store.list("gen/"))[:-1]
+                dangling_set = set(dangling_packs)
+                drop_ids: list[str] = []
+                unrecoverable: list[str] = []
+                for blob_id, (pack, *_rest) in self._index.items():
+                    if pack and pack in dangling_set:
+                        if blob_id in reach_hex:
+                            unrecoverable.append(blob_id)
+                        else:
+                            drop_ids.append(blob_id)
+                if orphan_packs:
+                    record_trigger("repo_orphan", packs=orphan_packs,
+                                   source="repair_scan")
+            gc = None
+            dropped = 0
+            if apply:
+                with span("repo.repair.resolve"):
+                    # A broken tree makes reach_hex a LOWER bound:
+                    # entries that look unreachable may hang off the
+                    # unreadable tree, so the drop is withheld (they
+                    # stay reported via dangling_entries_found).
+                    if drop_ids and not broken_trees:
+                        for blob_id in drop_ids:
+                            self._index.remove(blob_id)
+                        dropped = len(drop_ids)
+                        self._index.vacuum()
+                        baseline = set(self.store.list("index/"))
+                        new_keys = self._write_consolidated_index()
+                        for key in baseline - new_keys:
+                            self.store.delete(key)
+                        self._pending_index = {}
+                        self._pending_count = 0
+                        self._published_deltas = list(new_keys)
+                    for key in stale_markers:
+                        self.store.delete(key)
+                    for key in old_gens:
+                        self.store.delete(key)
+                    if not broken_trees and not unrecoverable:
+                        gc = self._prune_locked(grace)
+            return {
+                "applied": bool(apply),
+                "orphan_packs": orphan_packs,
+                "dangling_packs": dangling_packs,
+                "dangling_entries_dropped": dropped,
+                "dangling_entries_found": len(drop_ids),
+                "unrecoverable_blobs": sorted(unrecoverable),
+                "broken_trees": broken_trees,
+                "pending_manifests": len(manifests),
+                "expired_manifests": len(expired),
+                "stale_markers": sorted(stale_markers),
+                "gc": gc,
+            }
 
     # -- verification -------------------------------------------------------
 
