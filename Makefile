@@ -2,8 +2,9 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint test chaos chaos-concurrent static-check bench-index-smoke \
-	service-bench-smoke trace-smoke session-smoke clean-lint
+.PHONY: lint test chaos chaos-concurrent chaos-fleet static-check \
+	bench-index-smoke service-bench-smoke fleet-bench-smoke \
+	trace-smoke session-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105 + VL301 per-file + VL101-VL104
@@ -39,6 +40,18 @@ chaos-concurrent:
 	    tests/test_multiwriter.py \
 	    -q -m 'not slow' -p no:cacheprovider
 
+# Fleet replica drill (docs/service.md "Fleet operations"): 3 fenced
+# mover replicas on one repository plus a CONTINUOUS GC service under
+# the FLEET_SCHEDULES seeded fault matrix — kill-a-replica-mid-stream,
+# a store partition, GC-writer crash — asserting failover completes
+# every admitted job, the dead writer is fenced (StaleWriterError on
+# its late publish), no live pack is swept, and the ending
+# check(read_data=True) is clean; plus the fleet/GC/deadline unit
+# suite.
+chaos-fleet:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_chaos.py \
+	    tests/test_fleet.py -q -m 'not slow' -p no:cacheprovider
+
 static-check:
 	scripts/static_check.sh
 
@@ -56,6 +69,14 @@ bench-index-smoke:
 # accounting, provenance block) so the bench stays runnable.
 service-bench-smoke:
 	VOLSYNC_SVCBENCH_SMOKE=1 python scripts/service_bench.py
+
+# Fleet-mode service bench at smoke scale (docs/service.md): 2 replica
+# servers behind the FleetRouter with a mid-phase replica kill; the
+# script asserts the fleet JSON contract (per-replica breakdown, fleet
+# p50/p99 + goodput, failover accounting, kill event, provenance).
+fleet-bench-smoke:
+	VOLSYNC_SVCBENCH_SMOKE=1 VOLSYNC_SVCBENCH_REPLICAS=2 \
+	    VOLSYNC_SVCBENCH_KILL=1 python scripts/service_bench.py
 
 # Flight-recorder gate (docs/observability.md): a tiny pipelined backup
 # under a tenant-tagged trace must export a Perfetto-loadable

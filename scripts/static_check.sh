@@ -33,6 +33,17 @@
 #      step boundary, forced double-takeover — always ending in a
 #      clean check(read_data=True) with byte-identical restores
 #      (docs/robustness.md, "Multi-writer protocol").
+#   8. The fleet replica drill (`make chaos-fleet`): 3 fenced mover
+#      replicas + a continuous GC service under the FLEET_SCHEDULES
+#      seeded matrix — kill-a-replica-mid-stream, store partition,
+#      GC-writer crash — failover completes every admitted job, the
+#      dead writer's late publish is fenced, no live pack is swept
+#      (docs/service.md, "Fleet operations").
+#   9. The fleet-mode service bench at smoke scale
+#      (`make fleet-bench-smoke`): 2 replicas behind the FleetRouter
+#      with a mid-phase replica kill; asserts the fleet JSON contract
+#      (per-replica breakdown, fleet p50/p99 + goodput, failovers,
+#      kill event, provenance).
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -61,5 +72,11 @@ make --no-print-directory session-smoke
 
 echo "== chaos-concurrent =="
 make --no-print-directory chaos-concurrent
+
+echo "== chaos-fleet =="
+make --no-print-directory chaos-fleet
+
+echo "== fleet-bench-smoke =="
+make --no-print-directory fleet-bench-smoke > /dev/null
 
 echo "static_check: OK"

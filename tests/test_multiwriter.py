@@ -461,3 +461,94 @@ def test_repair_cli_exit_codes_and_json(tmp_path, capsys):
 
     # operational error -> exit 2
     assert repair_main([f"file://{tmp_path / 'nowhere'}"]) == 2
+
+
+def test_repair_concurrent_with_live_fenced_writers(tmp_path):
+    """``volsync repair`` while live fenced writers are mid-backup
+    (fleet operations runbook, docs/service.md): the scan must treat
+    the live writers' half-published state as in-flight, not debris —
+    it never drops an index entry a landed snapshot needs and never
+    sweeps a pack owned by a live writer generation. Pre-seeded debris
+    (an orphan pack, a stale fenced marker, a stale fleet stamp) is
+    still collected in the same pass."""
+    fs, pre_src, pre_snap, orphan = _damaged_repo(tmp_path)
+    old = (datetime.now(timezone.utc)
+           - timedelta(seconds=7200)).isoformat()
+    fs.put("fleet/deadreplica", json.dumps(
+        {"replica_id": "deadreplica", "address": "h:1", "headroom": 0,
+         "backlog": 0, "writer_id": "w", "generation": 1, "seq": 9,
+         "time": old}).encode())
+
+    trees = [_write_tree(tmp_path, f"live{t}", seed=21 + t)
+             for t in range(2)]
+    barrier = threading.Barrier(3)
+    snaps: list = [None, None]
+    errors: list = []
+    report: list = []
+
+    def writer(t):
+        try:
+            repo = Repository.open(fs)
+            repo.PACK_TARGET = 64 * 1024
+            repo.default_lock_wait = 10.0
+            barrier.wait(timeout=60)
+            snap, _ = TreeBackup(repo, workers=1).run(
+                trees[t], hostname=f"live{t}")
+            snaps[t] = snap
+        except Exception as e:  # surfaced via the errors assert below
+            errors.append((t, e))
+
+    def repairer():
+        try:
+            repo = Repository.open(fs)
+            repo.default_lock_wait = 10.0
+            barrier.wait(timeout=60)
+            report.append(repo.repair(grace_seconds=0.2))
+        except Exception as e:
+            errors.append(("repair", e))
+
+    threads = [threading.Thread(target=writer, args=(t,),
+                                name=f"live-writer-{t}")
+               for t in range(2)]
+    threads.append(threading.Thread(target=repairer, name="repairer"))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    assert all(snaps)
+
+    # repair collected the pre-seeded debris...
+    rep = report[0]
+    assert rep["applied"] is True
+    assert orphan in rep["orphan_packs"]
+    assert "fenced/deadwriter" in rep["stale_markers"]
+    assert "fleet/deadreplica" in rep["stale_markers"]
+    assert not fs.exists("fleet/deadreplica")
+    # ...without ever declaring a live writer's blobs unrecoverable or
+    # dropping entries out from under it
+    assert rep["unrecoverable_blobs"] == []
+    assert rep["broken_trees"] == []
+
+    # live writers were never fenced (only the stale marker's owner)
+    assert list(fs.list("fenced/")) == []
+
+    # end state: every snapshot (pre-existing + both landed mid-repair)
+    # restores byte-identically, no index entry references a missing
+    # pack — no live-generation pack was swept
+    check = Repository.open(fs)
+    assert check.check(read_data=True) == []
+    ids = [s[0] for s in check.list_snapshots()]
+    assert set(snaps) | {pre_snap} <= set(ids)
+    for src, snap in [(pre_src, pre_snap), (trees[0], snaps[0]),
+                      (trees[1], snaps[1])]:
+        dst = tmp_path / f"dst-{snap[:8]}"
+        prev = len(ids) - 1 - ids.index(snap)
+        restore_snapshot(Repository.open(fs), dst, previous=prev)
+        for f in sorted(p.name for p in src.iterdir()):
+            assert (dst / f).read_bytes() == (src / f).read_bytes(), f
+    with check._lock:
+        packs = [p for p in check._index.live_packs() if p]
+    for p in packs:
+        assert fs.exists(f"data/{p[:2]}/{p}"), \
+            f"repair swept live pack {p}"

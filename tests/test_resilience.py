@@ -14,6 +14,7 @@ from volsync_tpu.objstore.faultstore import (
     FaultSpec,
     FaultStore,
     InjectedCrash,
+    InjectedPartition,
     InjectedThrottle,
     default_specs,
     maybe_wrap,
@@ -465,6 +466,80 @@ def test_fault_throttle_kind():
                         FaultSpec(kind="throttle", at=1)]))
     with pytest.raises(InjectedThrottle):
         fs.put("k", b"v")
+
+
+def test_fault_partition_window_then_heals():
+    """``partition``: the store is unreachable for a DURATION, then
+    heals — distinct from ``crash``'s sticky death. Every op inside
+    the window raises InjectedPartition (retryable), none reaches the
+    backing store, and the first op past the window succeeds."""
+    clk = [0.0]
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="partition", at=1, op="put",
+                                  latency=2.0)]),
+                    clock=lambda: clk[0])
+    with pytest.raises(InjectedPartition):
+        fs.put("k", b"v")  # opens the window; the put never lands
+    assert not fs.inner.exists("k")
+    clk[0] = 1.0
+    with pytest.raises(InjectedPartition):
+        fs.get("k")  # still inside the window
+    with pytest.raises(InjectedPartition):
+        fs.put("k2", b"v")
+    assert not fs.inner.exists("k2")
+    clk[0] = 2.5  # window elapsed: healed, unlike crash
+    fs.put("k", b"v")
+    assert fs.get("k") == b"v"
+    # a policy that keeps retrying past the window succeeds: partition
+    # classifies as retryable (TransientError), crash as fatal
+    assert isinstance(InjectedPartition("x"), TransientError)
+
+
+def test_fault_partition_freezes_other_spec_counters():
+    """While partitioned, ops never reach the store, so other specs'
+    ``at=N`` arrival counters must NOT advance — the Nth real arrival
+    still fires after the window."""
+    clk = [0.0]
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="partition", at=1, op="put",
+                                  latency=5.0),
+                        FaultSpec(kind="transient", at=2, op="put")]),
+                    clock=lambda: clk[0])
+    with pytest.raises(InjectedPartition):
+        fs.put("a", b"x")  # partition fires on put arrival #1
+    for _ in range(5):  # blocked arrivals: counters frozen
+        with pytest.raises(InjectedPartition):
+            fs.put("b", b"x")
+    clk[0] = 6.0
+    with pytest.raises(FaultInjected):
+        fs.put("c", b"x")  # put arrival #2 — transient still fires
+    fs.put("d", b"x")
+    assert fs.get("d") == b"x"
+
+
+def test_fault_partition_parse_spec_and_default_duration():
+    """Spec string round-trip (``ms=`` maps to the window duration)
+    and the 5 s default when no duration is given."""
+    from volsync_tpu.objstore.faultstore import _PARTITION_DEFAULT_S
+
+    spec = parse_spec("partition:at=1,op=put,ms=2000")[0]
+    assert (spec.kind, spec.at, spec.op, spec.latency) \
+        == ("partition", 1, "put", 2.0)
+    clk = [0.0]
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="partition", at=1)]),
+                    clock=lambda: clk[0])
+    with pytest.raises(InjectedPartition):
+        fs.put("k", b"v")
+    clk[0] = _PARTITION_DEFAULT_S - 0.1
+    with pytest.raises(InjectedPartition):
+        fs.get("k")
+    clk[0] = _PARTITION_DEFAULT_S + 0.1
+    fs.put("k", b"v")
+    assert fs.get("k") == b"v"
 
 
 def test_fault_latency_sleeps(monkeypatch):

@@ -247,6 +247,38 @@ def svc_breaker_backend() -> Optional[str]:
     return env_str("VOLSYNC_SVC_BREAKER_BACKEND")
 
 
+def svc_deadline_spec() -> Optional[str]:
+    """VOLSYNC_SVC_DEADLINES: deadline-class map for the segment
+    scheduler, e.g. ``interactive=0.5,standard=5,background=none`` (see
+    scheduler.parse_deadline_classes); None = built-in defaults."""
+    return env_str("VOLSYNC_SVC_DEADLINES")
+
+
+# -- fleet replica plane (service/fleet.py, service/gc.py) ---------------
+
+def fleet_beat_seconds() -> float:
+    """VOLSYNC_FLEET_BEAT_S: interval between a replica's heartbeat
+    stamps into the shared object store (``fleet/<replica-id>``). The
+    stamp carries headroom + backlog, so the beat is also how fast the
+    router's routing picture refreshes."""
+    return env_float("VOLSYNC_FLEET_BEAT_S", 2.0, minimum=0.1)
+
+
+def fleet_ttl_seconds() -> float:
+    """VOLSYNC_FLEET_TTL_S: heartbeat-stamp TTL — a replica whose stamp
+    is older than this is presumed dead: the router stops routing to it
+    and ``volsync repair`` may clear the stale stamp. Keep it a few
+    beats wide so one slow put does not declare a live replica dead."""
+    return env_float("VOLSYNC_FLEET_TTL_S", 10.0, minimum=0.5)
+
+
+def gc_interval_seconds() -> float:
+    """VOLSYNC_GC_INTERVAL_S: pause between continuous-GC prune cycles
+    (service/gc.py). Each cycle is the two-phase mark-then-sweep prune;
+    the interval bounds how much garbage accumulates between cycles."""
+    return env_float("VOLSYNC_GC_INTERVAL_S", 60.0, minimum=0.1)
+
+
 # -- observability (obs/tracing.py) --------------------------------------
 
 def trace_dir() -> Optional[str]:

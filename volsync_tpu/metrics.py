@@ -141,6 +141,18 @@ class Metrics:
             "by tenant",
             ["tenant"], registry=self.registry,
         )
+        # Deadline-class scheduling (service/scheduler.py): segments
+        # whose queue-wait deadline passed before dispatch, shed with
+        # DeadlineExceeded instead of spending device work. A nonzero
+        # rate on an interactive class means the fleet needs headroom,
+        # not that the scheduler misbehaved — background classes
+        # (deadline None) never appear here.
+        self.svc_deadline_exceeded = Counter(
+            "volsync_svc_deadline_exceeded_total",
+            "Segments shed because their queue-wait deadline passed "
+            "before dispatch, by tenant",
+            ["tenant"], registry=self.registry,
+        )
         # Per-stream latency attribution (obs/tracing.py): seconds spent
         # per pipeline stage, summed over spans that finished under a
         # tenant-tagged TraceContext — where an admitted stream's time
@@ -221,6 +233,39 @@ class Metrics:
             "Results refused because the producing session's fencing "
             "epoch was stale",
             ["backend"], registry=self.registry,
+        )
+        # Fleet replica plane (service/fleet.py): per-replica advertised
+        # headroom from the last heartbeat stamp the router read, where
+        # the router sent each admitted stream, and how many streams
+        # completed on a sibling after their first-choice replica shed
+        # or died mid-stream. Replica label values are the group's own
+        # replica ids (bounded by fleet size, never client-supplied).
+        self.fleet_replica_headroom = Gauge(
+            "volsync_fleet_replica_headroom",
+            "Advertised admission headroom per replica, from its last "
+            "heartbeat stamp",
+            ["replica"], registry=self.registry,
+        )
+        self.fleet_routed_total = Counter(
+            "volsync_fleet_routed_total",
+            "Streams the fleet router sent to each replica",
+            ["replica"], registry=self.registry,
+        )
+        self.fleet_failovers_total = Counter(
+            "volsync_fleet_failovers_total",
+            "Streams that completed on a sibling after a shed or a "
+            "replica death",
+            registry=self.registry,
+        )
+        # Continuous GC service (service/gc.py): prune cycles by outcome
+        # — "ok" (cycle ran, repo swept), "contended" (another writer
+        # held a conflicting lock; normal under load), "fenced" (this
+        # GC writer lost a takeover and reopened), "error" (anything
+        # else; the service backs off and retries).
+        self.gc_cycles = Counter(
+            "volsync_gc_cycles_total",
+            "Continuous-GC prune cycles, by outcome",
+            ["outcome"], registry=self.registry,
         )
 
     def for_object(self, name: str, namespace: str, role: str,

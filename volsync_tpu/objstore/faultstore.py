@@ -25,6 +25,15 @@ ObjectStore and injects faults according to a seeded ``FaultSchedule``:
                     retryable — a stuck TCP connection that a NAT
                     eventually reaps. The way to exercise
                     ``DeadlineExceeded`` paths in chaos schedules.
+- ``partition``   — the store becomes unreachable for a DURATION
+                    (``ms=`` per hit, default 5 s) and then heals:
+                    every op inside the window fails retryable without
+                    reaching the store. Distinct from ``crash``'s
+                    sticky death — a replica that loses the network
+                    while its siblings keep writing comes back; the
+                    fleet drill's mid-outage failover rides this.
+                    While partitioned, other specs' counters do not
+                    advance (those ops never arrived at the store).
 
 Determinism: probability rolls are a pure hash of
 ``(seed, spec, op, key, nth-occurrence-of(op,key))`` — independent of
@@ -82,11 +91,19 @@ class InjectedHang(TransientError):
     already expired by the time this surfaces)."""
 
 
+class InjectedPartition(TransientError):
+    """The store is inside a scheduled partition window: unreachable
+    now, healed once the window elapses (retryable — a policy that
+    keeps trying past the window succeeds)."""
+
+
 #: default blocked time for a ``hang`` spec that carries no ``ms=``
 _HANG_DEFAULT_S = 60.0
+#: default outage length for a ``partition`` spec that carries no ``ms=``
+_PARTITION_DEFAULT_S = 5.0
 
 _KINDS = ("transient", "throttle", "latency", "partial_put",
-          "truncated_read", "crash", "hang")
+          "truncated_read", "crash", "hang", "partition")
 #: ops that mutate the store — the ones ``landed`` applies to
 _WRITE_OPS = ("put", "put_if_absent", "delete")
 
@@ -182,13 +199,18 @@ class FaultStore:
 
     def __init__(self, inner, schedule: Optional[FaultSchedule] = None,
                  *, seed: int = 0,
-                 sleep_fn=time.sleep):
+                 sleep_fn=time.sleep,
+                 clock=time.monotonic):
         self.inner = inner
         self.schedule = (schedule if schedule is not None
                          else FaultSchedule(seed=seed))
         self.injected: list[tuple[int, str, str, str]] = []
         self.crashed = False
         self._sleep = sleep_fn
+        # partition windows are judged by this clock (injectable so
+        # tests heal a partition without wall-clock waits)
+        self._clock = clock
+        self._partition_until = 0.0
         self._lock = lockcheck.make_lock("objstore.faults")
         self._op_count = 0
         # per-spec matching-op counters (for at=N) and per-(op,key)
@@ -206,6 +228,12 @@ class FaultStore:
                 raise InjectedCrash(
                     f"store is dead (earlier injected crash); {op} "
                     f"{key!r} refused")
+            if self._clock() < self._partition_until:
+                # inside an open partition window: the op never reaches
+                # the store, and no spec counter advances for it
+                raise InjectedPartition(
+                    f"store partitioned; {op} {key!r} unreachable for "
+                    f"{self._partition_until - self._clock():.3f}s more")
             self._op_count += 1
             opix = self._op_count
             n = self._occurrence.get((op, key), 0) + 1
@@ -239,9 +267,19 @@ class FaultStore:
             if spec.kind == "latency" and spec.latency > 0:
                 self._sleep(spec.latency)
         crash = next((s for s in fired if s.kind == "crash"), None)
+        part = next((s for s in fired if s.kind == "partition"), None)
         err = next((s for s in fired
                     if s.kind in ("transient", "throttle", "partial_put",
                                   "truncated_read", "hang")), None)
+        if part is not None:
+            duration = (part.latency if part.latency > 0
+                        else _PARTITION_DEFAULT_S)
+            with self._lock:
+                self._partition_until = max(self._partition_until,
+                                            self._clock() + duration)
+            raise InjectedPartition(
+                f"injected partition at {op} {key!r} "
+                f"(unreachable {duration:.3f}s)")
         if crash is not None:
             if crash.landed and op in _WRITE_OPS:
                 execute()

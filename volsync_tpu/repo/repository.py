@@ -1807,7 +1807,11 @@ class Repository:
                     if now >= deadline:
                         expired.append(key)
                 stale_markers = []
-                for prefix in ("takeover/", "fenced/"):
+                # fleet/ heartbeat stamps (service/fleet.py) join the
+                # marker scan: a stamp a replica never retired outlives
+                # its TTL by definition once it crosses the lock-stale
+                # horizon, and torn stamps are debris like torn markers
+                for prefix in ("takeover/", "fenced/", "fleet/"):
                     for key in list(self.store.list(prefix)):
                         try:
                             info = json.loads(self.store.get(key))

@@ -1,8 +1,11 @@
 """mover-jax: the TPU chunk/hash data plane as a gRPC service
 (BASELINE.json north star; SURVEY.md §2.3 communication backend),
 plus the multi-tenant service plane in front of it: admission control
-(service/admission.py), weighted deficit-round-robin scheduling
-(service/scheduler.py), and the tenancy model (service/tenants.py).
+(service/admission.py), weighted deficit-round-robin scheduling with
+deadline classes (service/scheduler.py), the tenancy model
+(service/tenants.py), and the fleet replica plane on top — N fenced
+server replicas on one repository with headroom routing
+(service/fleet.py) and a continuous GC service (service/gc.py).
 """
 
 from volsync_tpu.service.admission import (
@@ -11,7 +14,20 @@ from volsync_tpu.service.admission import (
     StreamTicket,
 )
 from volsync_tpu.service.client import MoverJaxClient, ShedError, open_client
-from volsync_tpu.service.scheduler import SchedulerStopped, SegmentScheduler
+from volsync_tpu.service.fleet import (
+    FleetRouter,
+    Replica,
+    ReplicaGroup,
+    ReplicaHeartbeat,
+    ReplicaStamp,
+)
+from volsync_tpu.service.gc import ContinuousGC
+from volsync_tpu.service.scheduler import (
+    DeadlineExceeded,
+    SchedulerStopped,
+    SegmentScheduler,
+    parse_deadline_classes,
+)
 from volsync_tpu.service.server import MoverJaxServer
 from volsync_tpu.service.tenants import (
     TenantConfig,
@@ -22,8 +38,15 @@ from volsync_tpu.service.tenants import (
 __all__ = [
     "AdmissionController",
     "AdmissionRejected",
+    "ContinuousGC",
+    "DeadlineExceeded",
+    "FleetRouter",
     "MoverJaxClient",
     "MoverJaxServer",
+    "Replica",
+    "ReplicaGroup",
+    "ReplicaHeartbeat",
+    "ReplicaStamp",
     "SchedulerStopped",
     "SegmentScheduler",
     "ShedError",
@@ -31,5 +54,6 @@ __all__ = [
     "TenantConfig",
     "TenantRegistry",
     "open_client",
+    "parse_deadline_classes",
     "sanitize_tenant",
 ]

@@ -24,6 +24,19 @@ Shed reasons:
 - ``draining``        — stop() is in progress; the server maps this
                         one to UNAVAILABLE, not RESOURCE_EXHAUSTED.
 
+Quota sheds carry a **decorrelated-jitter** retry-after hint (the
+resilience.py backoff discipline applied to hints): each hint is drawn
+from ``[base, prev*3]`` capped at 10x base, so N clients shed in the
+same instant retry spread out instead of re-colliding as a thundering
+herd — which matters once multiple fleet replicas share one backlog
+signal. Breaker sheds keep the breaker's exact remaining cooldown.
+
+Cross-replica admission (service/fleet.py): when a ``sibling_fn`` is
+wired, every shed also carries the address of a sibling replica with
+advertised headroom — the server stamps it into
+``x-volsync-sibling`` trailing metadata so a shed client retries
+*there* instead of re-offering the hot replica the same stream.
+
 Admitted/shed counts are exported per tenant as
 ``volsync_svc_admitted_total{tenant}`` /
 ``volsync_svc_shed_total{tenant,reason}``; active streams as a gauge.
@@ -31,6 +44,7 @@ Admitted/shed counts are exported per tenant as
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -45,15 +59,20 @@ from volsync_tpu.service.tenants import TenantRegistry
 
 class AdmissionRejected(Exception):
     """A stream shed at admission. ``retry_after`` is the hint in
-    seconds the server stamps into trailing metadata."""
+    seconds the server stamps into trailing metadata; ``sibling`` (when
+    a fleet router is wired) is the ``host:port`` of a replica with
+    advertised headroom the client should retry against."""
 
-    def __init__(self, tenant: str, reason: str, retry_after: float):
+    def __init__(self, tenant: str, reason: str, retry_after: float,
+                 sibling: Optional[str] = None):
+        at = f"; sibling {sibling}" if sibling else ""
         super().__init__(
             f"stream for tenant {tenant!r} shed at admission "
-            f"({reason}); retry after {retry_after:.3f}s")
+            f"({reason}); retry after {retry_after:.3f}s{at}")
         self.tenant = tenant
         self.reason = reason
         self.retry_after = retry_after
+        self.sibling = sibling
 
 
 @dataclass
@@ -67,6 +86,9 @@ class StreamTicket:
     #: TraceContext of the stream span — the handler threads it through
     #: the scheduler so device-batch spans attribute to this stream
     trace: object = None
+    #: relative queue-wait deadline (seconds) from the stream's
+    #: deadline class; None = no deadline (pure WDRR)
+    deadline: Optional[float] = None
     _released: bool = field(default=False, repr=False)
 
 
@@ -77,8 +99,11 @@ class AdmissionController:
 
     ``queue_depth_fn`` reports the scheduler's total queued segments
     (None = no segment-backlog gate). ``breaker`` is a
-    resilience.CircuitBreaker (or None). ``clock`` is injectable for
-    tests."""
+    resilience.CircuitBreaker (or None). ``sibling_fn`` (fleet mode)
+    returns the ``host:port`` of a sibling replica with headroom, or
+    None — attached to every shed. ``clock`` and ``jitter_rng`` are
+    injectable for tests (the rng drives the decorrelated retry-after
+    jitter; a seeded ``random.Random`` makes hints reproducible)."""
 
     def __init__(self, registry: TenantRegistry, *,
                  max_streams: Optional[int] = None,
@@ -87,7 +112,9 @@ class AdmissionController:
                  retry_after: Optional[float] = None,
                  breaker=None,
                  queue_depth_fn: Optional[Callable[[], int]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 sibling_fn: Optional[Callable[[], Optional[str]]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 jitter_rng: Optional[random.Random] = None):
         self.registry = registry
         self.max_streams = (envflags.svc_max_streams()
                             if max_streams is None else max(1, max_streams))
@@ -100,7 +127,15 @@ class AdmissionController:
                             if retry_after is None else retry_after)
         self.breaker = breaker
         self._queue_depth = queue_depth_fn
+        self._sibling = sibling_fn
         self._clock = clock
+        # decorrelated jitter over retry-after hints: state + rng live
+        # under the same lock as the counters (one shed = one draw)
+        self._rng = jitter_rng if jitter_rng is not None else random.Random()
+        self._hint_prev = self.retry_after
+        # own tiny lock: _shed runs both outside and INSIDE self._lock,
+        # so the jitter state cannot share it
+        self._hint_lock = lockcheck.make_lock("service.admission.hint")
         self._lock = lockcheck.make_lock("service.admission")
         self._counts: dict[str, int] = {}
         self._total = 0
@@ -137,15 +172,30 @@ class AdmissionController:
                 GLOBAL_METRICS.svc_active_streams.labels(tenant=tenant)
         return g
 
+    def _jittered_hint(self) -> float:
+        """Decorrelated jitter (resilience.py's backoff discipline) over
+        the base retry-after: each hint is uniform in [base, prev*3],
+        capped at 10x base. Clients shed in the same instant draw
+        different hints, so they do not return as a thundering herd."""
+        base = self.retry_after
+        with self._hint_lock:
+            hint = min(base * 10.0,
+                       self._rng.uniform(base, max(base, self._hint_prev * 3)))
+            self._hint_prev = hint
+        return hint
+
     def _shed(self, tenant: str, reason: str,
               retry_after: Optional[float] = None) -> AdmissionRejected:
         self._shed_counter(tenant, reason).inc()
+        sibling = self._sibling() if self._sibling is not None else None
         # Flight-recorder annotation: what the service was doing right
         # before it started refusing work (auto-dumps when armed).
-        record_trigger("shed", tenant=tenant, cause=reason)
+        record_trigger("shed", tenant=tenant, cause=reason,
+                       sibling=sibling)
         return AdmissionRejected(
             tenant, reason,
-            self.retry_after if retry_after is None else retry_after)
+            self._jittered_hint() if retry_after is None else retry_after,
+            sibling=sibling)
 
     # -- the gate ----------------------------------------------------------
 
@@ -214,3 +264,12 @@ class AdmissionController:
             if tenant is None:
                 return self._total
             return self._counts.get(tenant, 0)
+
+    def headroom(self) -> int:
+        """Streams this controller could still admit right now (0 while
+        draining) — what a fleet replica advertises in its heartbeat
+        stamp so the router can route new streams by capacity."""
+        with self._lock:
+            if self._draining:
+                return 0
+            return max(0, self.max_streams - self._total)

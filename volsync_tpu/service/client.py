@@ -25,8 +25,10 @@ from volsync_tpu.obs import begin_span, format_trace_header, new_id, new_trace
 from volsync_tpu.resilience import RetryPolicy, ThrottleError
 from volsync_tpu.service import moverjax_pb2 as pb
 from volsync_tpu.service.server import (
+    DEADLINE_CLASS_METADATA_KEY,
     RETRY_AFTER_METADATA_KEY,
     SERVICE_NAME,
+    SIBLING_METADATA_KEY,
     TOKEN_METADATA_KEY,
     TRACE_METADATA_KEY,
 )
@@ -38,22 +40,27 @@ _SEND_CHUNK = 4 * 1024 * 1024
 class ShedError(ThrottleError):
     """The service shed this call at admission. ``retry_after`` is the
     server's hint in seconds (falls back to 0.1 when the trailing
-    metadata is missing). Subclasses ThrottleError so
-    resilience.classify treats a shed as retryable backpressure."""
+    metadata is missing); ``sibling`` is the ``host:port`` of a fleet
+    sibling with headroom (None outside fleet mode) — retry THERE.
+    Subclasses ThrottleError so resilience.classify treats a shed as
+    retryable backpressure."""
 
-    def __init__(self, message: str, retry_after: float = 0.1):
+    def __init__(self, message: str, retry_after: float = 0.1,
+                 sibling: Optional[str] = None):
         super().__init__(message)
         self.retry_after = retry_after
+        self.sibling = sibling
 
 
 def shed_from_rpc(err: grpc.RpcError) -> Optional[ShedError]:
     """RESOURCE_EXHAUSTED RpcError -> ShedError (else None), reading
-    the retry-after hint from trailing metadata. Exposed for tests and
-    for callers driving the raw stubs."""
+    the retry-after hint and sibling address from trailing metadata.
+    Exposed for tests and for callers driving the raw stubs."""
     code = getattr(err, "code", None)
     if not callable(code) or code() != grpc.StatusCode.RESOURCE_EXHAUSTED:
         return None
     retry_after = 0.1
+    sibling = None
     trailing = getattr(err, "trailing_metadata", None)
     pairs = trailing() if callable(trailing) else None
     for key, value in pairs or ():
@@ -62,21 +69,32 @@ def shed_from_rpc(err: grpc.RpcError) -> Optional[ShedError]:
                 retry_after = max(0.001, float(value) / 1000.0)
             except ValueError:
                 pass  # unparsable hint: keep the default
-            break
+        elif key == SIBLING_METADATA_KEY:
+            sibling = str(value) or None
     details = getattr(err, "details", None)
     message = details() if callable(details) else str(err)
-    return ShedError(message or "shed at admission", retry_after)
+    return ShedError(message or "shed at admission", retry_after,
+                     sibling=sibling)
 
 
 class MoverJaxClient:
+    """``deadline_class`` (fleet deadline scheduling) names the
+    scheduler class this client's segments bill to — rides
+    ``x-volsync-deadline-class`` request metadata; None = no class
+    (pure WDRR)."""
+
     def __init__(self, address: str, port: int, token: str,
-                 timeout: float = 60.0, tenant: Optional[str] = None):
+                 timeout: float = 60.0, tenant: Optional[str] = None,
+                 deadline_class: Optional[str] = None):
         self._channel = grpc.insecure_channel(f"{address}:{port}")
         meta = [(TOKEN_METADATA_KEY, token)]
         if tenant:
             meta.append((TENANT_METADATA_KEY, tenant))
+        if deadline_class:
+            meta.append((DEADLINE_CLASS_METADATA_KEY, deadline_class))
         self._meta = tuple(meta)
         self.tenant = tenant
+        self.deadline_class = deadline_class
         self._timeout = timeout
         # Unary calls retry under the shared policy (grpc.RpcError's
         # .code() is classified: UNAVAILABLE-family retries,
@@ -190,5 +208,7 @@ class MoverJaxClient:
 
 
 def open_client(address: str, port: int, token: str,
-                tenant: Optional[str] = None) -> MoverJaxClient:
-    return MoverJaxClient(address, port, token, tenant=tenant)
+                tenant: Optional[str] = None,
+                deadline_class: Optional[str] = None) -> MoverJaxClient:
+    return MoverJaxClient(address, port, token, tenant=tenant,
+                          deadline_class=deadline_class)

@@ -23,10 +23,26 @@ the batcher are themselves windowed (``dispatch_window``) so the
 scheduler cannot flood the batcher queue and recreate the FIFO it
 replaced.
 
+Deadline classes over WDRR (PR 7's named follow-on): a segment may
+carry a relative ``deadline`` (seconds of queue wait it can absorb).
+Within a tenant the scheduler serves **earliest-deadline-first** —
+deadline-free segments rank last, FIFO among themselves — and a
+segment whose deadline has already passed when its dispatch turn comes
+is shed with a typed :class:`DeadlineExceeded` BEFORE it costs a
+batcher slot or device work: at overload, late work is dropped at the
+cheapest point instead of wasting the device on answers nobody is
+waiting for. Cross-tenant isolation stays WDRR's job — a saturated
+background class cannot move another tenant's p99 because deficits,
+not deadlines, divide the quantum. Class names map to relative
+deadlines via :func:`parse_deadline_classes`
+(``VOLSYNC_SVC_DEADLINES``, e.g. ``interactive=0.5,background=none``).
+
 Observability: ``volsync_svc_queue_depth{tenant}`` tracks backlog,
 ``volsync_svc_sched_latency_seconds{tenant}`` the queue wait of the
-most recently dispatched segment, and each dispatch runs under a
-``svc.schedule`` span.
+most recently dispatched segment,
+``volsync_svc_deadline_exceeded_total{tenant}`` counts deadline sheds
+(each also drops a ``deadline`` trigger into the flight recorder), and
+each dispatch runs under a ``svc.schedule`` span.
 """
 
 from __future__ import annotations
@@ -42,13 +58,65 @@ from concurrent.futures import Future
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
-from volsync_tpu.obs import begin_span, span, use_context
+from volsync_tpu.obs import begin_span, record_trigger, span, use_context
 from volsync_tpu.service.tenants import TenantRegistry
 
 
 class SchedulerStopped(RuntimeError):
     """Work refused or stranded because the scheduler is shutting
     down; the server maps it to a clean UNAVAILABLE."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A segment's queue-wait deadline passed before dispatch; the
+    scheduler shed it without spending a batcher slot or device work.
+    The server maps it to gRPC DEADLINE_EXCEEDED."""
+
+    def __init__(self, tenant: str, waited: float, deadline: float):
+        super().__init__(
+            f"segment for tenant {tenant!r} shed after {waited:.3f}s "
+            f"queue wait (deadline {deadline:.3f}s)")
+        self.tenant = tenant
+        self.waited = waited
+        self.deadline = deadline
+
+
+#: Built-in deadline classes: relative seconds of queue wait a segment
+#: of that class tolerates, None = no deadline (pure WDRR behaviour).
+#: Override with VOLSYNC_SVC_DEADLINES.
+DEFAULT_DEADLINE_CLASSES: dict = {
+    "interactive": 0.5,
+    "standard": 5.0,
+    "background": None,
+}
+
+
+def parse_deadline_classes(spec: str) -> dict:
+    """Parse ``name=seconds[,name=...]`` (``none``/``inf`` = no
+    deadline) into a class map; empty spec returns the defaults."""
+    if not spec.strip():
+        return dict(DEFAULT_DEADLINE_CLASSES)
+    classes: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, value = part.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(f"bad deadline class {part!r} "
+                             "(want name=seconds or name=none)")
+        value = value.strip().lower()
+        if value in ("none", "inf", ""):
+            classes[name] = None
+        else:
+            seconds = float(value)
+            if seconds <= 0:
+                raise ValueError(
+                    f"deadline for class {name!r} must be > 0, "
+                    f"got {seconds}")
+            classes[name] = seconds
+    return classes
 
 
 @dataclass
@@ -60,6 +128,9 @@ class _Item:
     tenant: str
     enqueued_at: float
     cost: int  # bytes (>= 1 so empty eof flushes still cost a unit)
+    #: absolute clock time after which dispatch is pointless
+    #: (None = no deadline, ranks last within the tenant)
+    deadline: Optional[float] = None
     #: the submitting stream's TraceContext, carried across the
     #: collector-thread seam so dispatch/batch spans attribute to it
     ctx: object = None
@@ -113,6 +184,8 @@ class SegmentScheduler:
         self.dispatch_window = dispatch_window
         self._queued = 0
         self._dispatched = 0
+        # cached per-tenant deadline-shed counter children
+        self._deadline_c: dict = {}
         self._work = threading.Event()
         self._stopped = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -142,13 +215,18 @@ class SegmentScheduler:
             return st
 
     def submit(self, tenant: str, data: bytes, length: int,
-               eof: bool, ctx=None) -> Future:
+               eof: bool, ctx=None,
+               deadline: Optional[float] = None) -> Future:
         """Enqueue one segment; the future resolves with the batcher's
         (chunks, consumed). Blocks — the credit-based pause — while the
         tenant's queue is at its bound. ``ctx`` is the submitting
         stream's TraceContext (or None): queue-wait and device-batch
         spans attribute to it even though they finish on the collector
-        and batcher threads."""
+        and batcher threads. ``deadline`` is RELATIVE seconds of queue
+        wait this segment tolerates (None = unbounded): within the
+        tenant it is served earliest-deadline-first, and if it is still
+        queued when the deadline passes the future fails with
+        :class:`DeadlineExceeded` instead of reaching the device."""
         st = self._state_for(tenant)
         while not st.credits.acquire(timeout=0.1):
             if self._stopped.is_set():
@@ -156,9 +234,11 @@ class SegmentScheduler:
         if self._stopped.is_set():
             st.credits.release()
             raise SchedulerStopped("scheduler stopped")
+        now = self._clock()
         item = _Item(data=data, length=length, eof=eof, future=Future(),
-                     tenant=tenant, enqueued_at=self._clock(),
+                     tenant=tenant, enqueued_at=now,
                      cost=max(1, length), ctx=ctx,
+                     deadline=None if deadline is None else now + deadline,
                      qspan=begin_span("svc.queue_wait", ctx=ctx))
         with self._lock:
             st.q.append(item)
@@ -181,6 +261,17 @@ class SegmentScheduler:
 
     # -- collector side ----------------------------------------------------
 
+    @staticmethod
+    def _edf_index(q: deque) -> int:
+        """Index of the segment to serve next within one tenant:
+        earliest absolute deadline first, deadline-free segments last,
+        FIFO among equals (queue order IS arrival order)."""
+        return min(range(len(q)),
+                   key=lambda i: (q[i].deadline is None,
+                                  q[i].deadline
+                                  if q[i].deadline is not None else 0.0,
+                                  i))
+
     def service_round(self) -> bool:
         """One deficit-round-robin pass over all backlogged tenants.
         Returns False when there was nothing to do."""
@@ -196,8 +287,16 @@ class SegmentScheduler:
                     st.deficit = 0.0
                     continue
                 st.deficit += float(self._quantum) * st.weight
-                while st.q and st.q[0].cost <= st.deficit:
-                    item = st.q.popleft()
+                # EDF within the tenant: the most urgent segment is the
+                # one the deficit must cover — if it does not fit yet we
+                # wait (skipping to a cheaper, later segment would
+                # starve exactly the work with the tightest deadline)
+                while st.q:
+                    idx = self._edf_index(st.q)
+                    if st.q[idx].cost > st.deficit:
+                        break
+                    item = st.q[idx]
+                    del st.q[idx]
                     st.deficit -= item.cost
                     self._queued -= 1
                     ready.append(item)
@@ -212,7 +311,31 @@ class SegmentScheduler:
                 self._dispatch(st, item)
         return True
 
+    def _deadline_counter(self, tenant: str):
+        c = self._deadline_c.get(tenant)
+        if c is None:
+            c = self._deadline_c[tenant] = \
+                GLOBAL_METRICS.svc_deadline_exceeded.labels(tenant=tenant)
+        return c
+
     def _dispatch(self, st: _TenantState, item: _Item) -> None:
+        # deadline shed BEFORE the slot acquire: an expired segment must
+        # not cost a batcher slot, a device batch, or the wait for
+        # either — dropping late work here is the whole point of
+        # deadline classes
+        if item.deadline is not None:
+            now = self._clock()
+            if now > item.deadline:
+                if item.qspan is not None:
+                    item.qspan.finish("error")
+                self._deadline_counter(item.tenant).inc()
+                record_trigger("deadline", tenant=item.tenant,
+                               waited=round(now - item.enqueued_at, 4))
+                if not item.future.done():
+                    item.future.set_exception(DeadlineExceeded(
+                        item.tenant, now - item.enqueued_at,
+                        item.deadline - item.enqueued_at))
+                return
         # windowed handoff to the batcher: wait for a slot, interrupted
         # by stop (stranded items are failed, never lost)
         while not self._slots.acquire(timeout=0.1):

@@ -60,7 +60,12 @@ from volsync_tpu.service.admission import (
     AdmissionController,
     AdmissionRejected,
 )
-from volsync_tpu.service.scheduler import SchedulerStopped, SegmentScheduler
+from volsync_tpu.service.scheduler import (
+    DeadlineExceeded,
+    SchedulerStopped,
+    SegmentScheduler,
+    parse_deadline_classes,
+)
 from volsync_tpu.service.tenants import TenantRegistry
 
 log = logging.getLogger("volsync_tpu.moverjax")
@@ -69,9 +74,16 @@ SERVICE_NAME = "moverjax.MoverJax"
 TOKEN_METADATA_KEY = "x-volsync-token"
 #: trailing-metadata key carrying the shed retry-after hint (ms)
 RETRY_AFTER_METADATA_KEY = "x-volsync-retry-after-ms"
+#: trailing-metadata key carrying a sibling replica's host:port on a
+#: shed, when a fleet router is wired (cross-replica admission: retry
+#: THERE, not here)
+SIBLING_METADATA_KEY = "x-volsync-sibling"
 #: request-metadata key carrying the client's trace context
 #: (obs.format_trace_header) so client + server spans join one trace
 TRACE_METADATA_KEY = "x-volsync-trace"
+#: request-metadata key naming the stream's deadline class
+#: (scheduler.parse_deadline_classes); unknown/absent = no deadline
+DEADLINE_CLASS_METADATA_KEY = "x-volsync-deadline-class"
 
 #: Stream segmentation mirrors engine/chunker.stream_chunks: a segment is
 #: processed once at least this much beyond max_size is buffered.
@@ -126,7 +138,15 @@ class MoverJaxServer:
     configure the admission controller (defaults from VOLSYNC_SVC_*).
     ``breaker`` wires load-shedding to a resilience circuit breaker —
     pass a CircuitBreaker, a backend name (resolved via breaker_for),
-    or leave None to follow VOLSYNC_SVC_BREAKER_BACKEND."""
+    or leave None to follow VOLSYNC_SVC_BREAKER_BACKEND.
+
+    Fleet mode (service/fleet.py): ``sibling_fn`` returns a sibling
+    replica's ``host:port`` with headroom (or None) — stamped into
+    ``x-volsync-sibling`` trailing metadata on every shed so clients
+    fail over instead of hammering this replica. ``deadline_classes``
+    maps ``x-volsync-deadline-class`` request-metadata names to
+    relative queue-wait deadlines (None entry = no deadline); defaults
+    follow VOLSYNC_SVC_DEADLINES."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  token: Optional[str] = None, params=None,
@@ -140,7 +160,9 @@ class MoverJaxServer:
                  tenant_streams: Optional[int] = None,
                  max_queued: Optional[int] = None,
                  stream_credits: Optional[int] = None,
-                 scheduler_quantum: Optional[int] = None):
+                 scheduler_quantum: Optional[int] = None,
+                 sibling_fn=None,
+                 deadline_classes: Optional[dict] = None):
         from volsync_tpu.engine.chunker import DeviceChunkHasher
         from volsync_tpu.ops.gearcdc import DEFAULT_PARAMS
 
@@ -185,10 +207,15 @@ class MoverJaxServer:
                 tenant_streams=tenant_streams, max_queued=max_queued,
                 breaker=breaker,
                 queue_depth_fn=(self._scheduler.queued_total
-                                if self._scheduler is not None else None))
+                                if self._scheduler is not None else None),
+                sibling_fn=sibling_fn)
         self._stream_credits = (envflags.svc_stream_credits()
                                 if stream_credits is None
                                 else max(1, stream_credits))
+        if deadline_classes is None:
+            deadline_classes = parse_deadline_classes(
+                envflags.svc_deadline_spec() or "")
+        self.deadline_classes = deadline_classes
 
         serialize = lambda m: m.SerializeToString()  # noqa: E731
         handlers = {
@@ -287,16 +314,26 @@ class MoverJaxServer:
                 ticket = self._admission.admit_stream(tenant)
         except AdmissionRejected as rej:
             handle.finish("error")
-            context.set_trailing_metadata((
-                (RETRY_AFTER_METADATA_KEY,
-                 str(max(1, int(rej.retry_after * 1000)))),))
+            trailing = [(RETRY_AFTER_METADATA_KEY,
+                         str(max(1, int(rej.retry_after * 1000))))]
+            if rej.sibling:
+                trailing.append((SIBLING_METADATA_KEY, rej.sibling))
+            context.set_trailing_metadata(tuple(trailing))
             code = (grpc.StatusCode.UNAVAILABLE if rej.reason == "draining"
                     else grpc.StatusCode.RESOURCE_EXHAUSTED)
             context.abort(code, str(rej))
             return  # pragma: no cover — abort raises
         ticket.trace = stream_ctx
+        # deadline class rides request metadata; an unknown class name
+        # degrades to no deadline (never rejects the stream)
+        cls = meta.get(DEADLINE_CLASS_METADATA_KEY)
+        if cls is not None:
+            ticket.deadline = self.deadline_classes.get(str(cls))
         try:
             yield from self._serve_stream(request_iterator, ticket)
+        except DeadlineExceeded as exc:
+            handle.finish("error")
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
         except (SchedulerStopped, BatcherStopped):
             handle.finish("error")
             context.abort(grpc.StatusCode.UNAVAILABLE,
@@ -316,7 +353,8 @@ class MoverJaxServer:
         if self._scheduler is not None:
             return self._scheduler.submit(ticket.tenant, data,
                                           len(data), eof,
-                                          ctx=ticket.trace)
+                                          ctx=ticket.trace,
+                                          deadline=ticket.deadline)
         f: Future = Future()
         handle = begin_span("svc.batch", ctx=ticket.trace)
         try:
