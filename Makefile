@@ -2,9 +2,10 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint test chaos chaos-concurrent chaos-fleet static-check \
-	bench-index-smoke service-bench-smoke fleet-bench-smoke \
-	trace-smoke session-smoke clean-lint
+.PHONY: lint test chaos chaos-concurrent chaos-fleet chaos-restore \
+	static-check bench-index-smoke service-bench-smoke \
+	fleet-bench-smoke restore-bench-smoke trace-smoke session-smoke \
+	clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105 + VL301 per-file + VL101-VL104
@@ -52,6 +53,16 @@ chaos-fleet:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_chaos.py \
 	    tests/test_fleet.py -q -m 'not slow' -p no:cacheprovider
 
+# Restore-storm chaos drill (docs/robustness.md): N concurrent
+# pipelined restores sharing one PackCache over seeded read-path fault
+# schedules (transient, truncated reads, a store partition) — every
+# destination byte-identical, each pack crossing the wire ~once for the
+# whole storm (single-flight), and a crash mid-fetch leaving no partial
+# file; plus the golden serial≡pipelined byte-identity suite.
+chaos-restore:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_restore_chaos.py \
+	    tests/test_restorepipe.py -q -m 'not slow' -p no:cacheprovider
+
 static-check:
 	scripts/static_check.sh
 
@@ -77,6 +88,14 @@ service-bench-smoke:
 fleet-bench-smoke:
 	VOLSYNC_SVCBENCH_SMOKE=1 VOLSYNC_SVCBENCH_REPLICAS=2 \
 	    VOLSYNC_SVCBENCH_KILL=1 python scripts/service_bench.py
+
+# Restore data plane bench at smoke scale (docs/performance.md,
+# "Restore data plane"): serial-vs-pipelined-vs-storm over a 40 ms
+# fake store; asserts its JSON contract stays runnable (speedup,
+# storm_fetch_ratio, cache hit ratio, per-stage spans, provenance).
+# Scale-accurate numbers need the full run: `python bench.py restore`.
+restore-bench-smoke:
+	python bench.py restore --smoke
 
 # Flight-recorder gate (docs/observability.md): a tiny pipelined backup
 # under a tenant-tagged trace must export a Perfetto-loadable

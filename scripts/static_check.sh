@@ -44,6 +44,15 @@
 #      with a mid-phase replica kill; asserts the fleet JSON contract
 #      (per-replica breakdown, fleet p50/p99 + goodput, failovers,
 #      kill event, provenance).
+#  10. The restore-storm chaos drill (`make chaos-restore`): the golden
+#      serial≡pipelined byte-identity suite plus N concurrent restores
+#      sharing one PackCache under seeded read-path faults — identical
+#      trees, single-flight pack fetches, no partial file on a crashed
+#      restore (docs/robustness.md, "Restore storms").
+#  11. The restore bench at smoke scale (`make restore-bench-smoke`):
+#      serial vs pipelined vs storm over the 40 ms fake store; keeps
+#      the restore data plane's JSON contract runnable
+#      (docs/performance.md, "Restore data plane").
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -78,5 +87,11 @@ make --no-print-directory chaos-fleet
 
 echo "== fleet-bench-smoke =="
 make --no-print-directory fleet-bench-smoke > /dev/null
+
+echo "== chaos-restore =="
+make --no-print-directory chaos-restore
+
+echo "== restore-bench-smoke =="
+make --no-print-directory restore-bench-smoke > /dev/null
 
 echo "static_check: OK"

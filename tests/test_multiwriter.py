@@ -346,6 +346,76 @@ def test_backup_lands_while_victims_await_sweep(tmp_path):
     assert Repository.open(fs).check(read_data=True) == []
 
 
+class _PruneOnFirstPackGet:
+    """Store shim that fires a callback at the FIRST whole-pack GET —
+    i.e. after the pipelined restore has planned against the old index
+    but before any pack body arrives."""
+
+    def __init__(self, inner, fire):
+        self.inner = inner
+        self._fire = fire
+        self._fired = False
+        self.pack_keys: list[str] = []
+
+    def get(self, key):
+        if key.startswith("data/"):
+            self.pack_keys.append(key)
+            if not self._fired:
+                self._fired = True
+                self._fire()
+        return self.inner.get(key)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_pipelined_restore_tolerates_concurrent_prune(tmp_path):
+    """A pipelined restore whose fetch window overlaps a two-phase
+    prune: the plan was made against the pre-prune index, the mark
+    phase rewrites live blobs and parks the old packs — and the
+    in-flight fetches still read the parked packs (pending-delete
+    means *deferred*, not deleted) for a byte-identical restore."""
+    fs = FsObjectStore(str(tmp_path / "store"))
+    Repository.init(fs, chunker=CHUNKER)
+    seed = Repository.open(fs)
+    seed.PACK_TARGET = 64 * 1024
+    # small files so several share a pack: the doomed file's blobs sit
+    # NEXT TO live blobs, forcing the mark phase to rewrite + park the
+    # mixed pack (a pure-garbage pack would park without any overlap)
+    src = _write_tree(tmp_path, "src", seed=21, files=6, size=15_000)
+    doomed, _ = TreeBackup(seed, workers=1).run(src)
+    (src / "f0.bin").unlink()  # first-packed file: shares its pack
+    #                            with still-live neighbours
+    kept, _ = TreeBackup(seed, workers=1).run(src)
+    seed.delete_snapshot(doomed)  # f0's blobs are now garbage
+
+    report = {}
+
+    def fire():
+        # runs inside a restore fetch-pool thread, while the restore
+        # holds its shared lock — prune-mode coexists with shared
+        report.update(Repository.open(fs).prune(grace_seconds=3600))
+
+    shim = _PruneOnFirstPackGet(fs, fire)
+    stats = restore_snapshot(Repository.open(shim), tmp_path / "dst")
+    assert stats and stats["files"] == 5
+
+    # the prune really overlapped: it marked packs, and the restore
+    # went on to read at least one pack that is now parked
+    assert report.get("packs_pending", 0) > 0
+    pending = set()
+    for key in fs.list("pending-delete/"):
+        pending.update(json.loads(fs.get(key))["packs"])
+    fetched = {k.rsplit("/", 1)[1] for k in shim.pack_keys}
+    assert fetched & pending, \
+        "restore never touched a parked pack — the race didn't happen"
+
+    for f in sorted(p.name for p in src.iterdir()):
+        assert (tmp_path / "dst" / f).read_bytes() == \
+            (src / f).read_bytes(), f
+    assert Repository.open(fs).check(read_data=True) == []
+
+
 # -- repair ----------------------------------------------------------------
 
 

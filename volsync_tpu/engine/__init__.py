@@ -12,10 +12,12 @@ from volsync_tpu.engine.chunker import (
     stream_chunks,
 )
 from volsync_tpu.engine.restore import TreeRestore, restore_snapshot
+from volsync_tpu.engine.restorepipe import RestoreGroup
 
 __all__ = [
     "TreeBackup",
     "TreeRestore",
+    "RestoreGroup",
     "restore_snapshot",
     "DeviceChunkHasher",
     "stream_chunks",

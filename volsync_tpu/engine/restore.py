@@ -20,17 +20,32 @@ from volsync_tpu.repo.repository import Repository
 
 
 class TreeRestore:
-    def __init__(self, repo: Repository, *, workers: Optional[int] = None):
+    def __init__(self, repo: Repository, *, workers: Optional[int] = None,
+                 pipeline: Optional[bool] = None):
         """``workers`` restores that many files concurrently (default 4,
         env VOLSYNC_RESTORE_WORKERS): blob reads (store IO + decrypt)
         overlap file writes across independent files. Directory
         modes/mtimes are applied in a bottom-up pass AFTER every file
         write, so concurrent writes can't bump an already-stamped parent
-        mtime."""
+        mtime.
+
+        ``pipeline`` selects the pack-aware restore data plane
+        (engine/restorepipe.py): fetches are planned per PACK, pulled
+        through a shared single-flight PackCache by a bounded async
+        pool, device-verified in ~64 MiB batches, and written at
+        planned offsets. Default from VOLSYNC_RESTORE_PIPELINE (on);
+        ``pipeline=False`` is the serial per-blob oracle the golden
+        suite compares against."""
         self.repo = repo
         if workers is None:
             workers = envflags.restore_workers()
         self.workers = max(1, workers)
+        if pipeline is None:
+            pipeline = envflags.restore_pipeline_enabled()
+        self.pipelined = pipeline
+        # a RestoreGroup injects its shared cache here; None means the
+        # pipelined path builds a private one per run
+        self.pack_cache = None
         # Device-batched blob verification (same knob as repository
         # check): per-byte re-hashing rides the page-grid kernel in
         # ~64 MiB batches, host keeps only decrypt/decompress. Batches
@@ -66,17 +81,7 @@ class TreeRestore:
         self._walk_tree(manifest["tree"], dest, stats, jobs, dirs, links,
                         delete_extra=delete_extra)
         if jobs:
-            if self.workers > 1 and len(jobs) > 1:
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(self.workers) as pool:
-                    results = list(pool.map(
-                        lambda j: self._restore_file(*j), jobs))
-            else:
-                results = [self._restore_file(*j) for j in jobs]
-            for key, nbytes in results:
-                stats[key] += 1
-                stats["bytes"] += nbytes
+            self._restore_files(jobs, stats)
         # Hardlinks AFTER the file pool: the link's source path is only
         # guaranteed to exist (with final content) once every file job
         # has run. Metadata is shared with the source inode, already
@@ -187,20 +192,49 @@ class TreeRestore:
         os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
         stats["files"] += 1
 
-    def _restore_file(self, entry: dict, target: Path) -> tuple[str, int]:
+    def _restore_files(self, jobs: list, stats: dict) -> None:
+        """Restore every (entry, target) file job. Pipelined mode
+        (VOLSYNC_RESTORE_PIPELINE, default on) plans pack-granular
+        fetches and device-verifies in batches
+        (engine/restorepipe.py); the serial fallback reads blob by
+        blob under the per-file worker pool — the golden oracle."""
+        if self.pipelined:
+            from volsync_tpu.engine.restorepipe import (
+                restore_files_pipelined,
+            )
+
+            restore_files_pipelined(self, jobs, stats)
+            return
+        if self.workers > 1 and len(jobs) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(self.workers) as pool:
+                results = list(pool.map(
+                    lambda j: self._restore_file(*j), jobs))
+        else:
+            results = [self._restore_file(*j) for j in jobs]
+        for key, nbytes in results:
+            stats[key] += 1
+            stats["bytes"] += nbytes
+
+    def _skip_unchanged(self, entry: dict, target: Path) -> bool:
+        """The unchanged-file heuristic (size+mtime_ns, same keys
+        backup trusts). Skipped files still get owner/mode/xattrs
+        re-applied: those drift without touching mtime (they update
+        only ctime) — xattrs first (a read-only final mode would block
+        setxattr for unprivileged restores), chown before chmod (chown
+        clears setuid bits)."""
         if (target.is_file() and not target.is_symlink()
                 and target.stat().st_size == entry["size"]
                 and target.stat().st_mtime_ns == entry["mtime_ns"]):
-            # Content is trusted unchanged (size+mtime_ns, the same
-            # heuristic backup uses), but owner/mode/xattrs can drift
-            # without touching mtime (they update only ctime) —
-            # re-apply all three: xattrs first (a read-only final mode
-            # would block setxattr for unprivileged restores), chown
-            # before chmod (chown clears setuid bits).
             _apply_xattrs(target, entry)
             _apply_owner(target, entry)
             os.chmod(target, entry["mode"])
-            return "skipped", 0
+            return True
+        return False
+
+    def _clear_target(self, target: Path) -> None:
+        """Make ``target`` writable as a fresh regular file."""
         if target.is_symlink() or target.is_dir():
             _rmtree(target)
         elif target.exists():
@@ -218,6 +252,20 @@ class TreeRestore:
                 # inode and corrupt the other linked path (and race
                 # against its own restore job under the worker pool).
                 target.unlink()
+
+    def _finalize_file(self, entry: dict, target: Path) -> None:
+        """Post-content metadata stamp, shared by both restore paths:
+        xattrs before chmod (read-only modes), chown before chmod
+        (chown clears suid), mtime last."""
+        _apply_xattrs(target, entry)
+        _apply_owner(target, entry)
+        os.chmod(target, entry["mode"])
+        os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
+
+    def _restore_file(self, entry: dict, target: Path) -> tuple[str, int]:
+        if self._skip_unchanged(entry, target):
+            return "skipped", 0
+        self._clear_target(target)
         write = _write_sparse if self.sparse else (
             lambda f_, d: f_.write(d))
         with open(target, "wb") as f:
@@ -229,10 +277,7 @@ class TreeRestore:
             if self.sparse:
                 # materialize a trailing hole (seek alone doesn't extend)
                 f.truncate(f.tell())
-        _apply_xattrs(target, entry)  # before chmod (read-only modes)
-        _apply_owner(target, entry)   # before chmod (chown clears suid)
-        os.chmod(target, entry["mode"])
-        os.utime(target, ns=(entry["mtime_ns"], entry["mtime_ns"]))
+        self._finalize_file(entry, target)
         return "files", entry["size"]
 
     _VERIFY_BATCH = 64 * 1024 * 1024

@@ -154,6 +154,38 @@ def restore_workers() -> int:
     return env_int("VOLSYNC_RESTORE_WORKERS", 4, minimum=1)
 
 
+# -- restore data plane (engine/restorepipe.py, repo/packcache.py) -------
+
+def restore_pipeline_enabled() -> bool:
+    """Master switch for the pipelined restore data plane
+    (pack-granular fetches + device-batched verify).
+    ``VOLSYNC_RESTORE_PIPELINE=0`` falls back to the serial per-blob
+    path — the byte-identity golden oracle."""
+    return env_bool("VOLSYNC_RESTORE_PIPELINE", True)
+
+
+def restore_cache_mb() -> int:
+    """VOLSYNC_RESTORE_CACHE_MB: byte budget (MiB) of the shared
+    PackCache LRU in front of the object store. Concurrent restores
+    sharing one cache fetch each pack once (single-flight) and evict
+    oldest-first past this budget."""
+    return env_int("VOLSYNC_RESTORE_CACHE_MB", 256, minimum=1)
+
+
+def restore_fetchers() -> int:
+    """VOLSYNC_RESTORE_FETCHERS: worker threads in the restore
+    pipeline's async pack-fetch pool (store GETs overlap decode,
+    device verify, and file writes)."""
+    return env_int("VOLSYNC_RESTORE_FETCHERS", 4, minimum=1)
+
+
+def restore_fetch_window() -> int:
+    """VOLSYNC_RESTORE_FETCH_WINDOW: max pack fetches submitted ahead
+    of the consuming verify/write stage — the backpressure bound on
+    fetched-but-unwritten pack bytes (window x PACK_TARGET)."""
+    return env_int("VOLSYNC_RESTORE_FETCH_WINDOW", 8, minimum=1)
+
+
 # -- metadata plane (repo/shardedindex.py) -------------------------------
 
 def index_shards() -> int:

@@ -257,6 +257,35 @@ class Metrics:
             "replica death",
             registry=self.registry,
         )
+        # Restore data plane (engine/restorepipe.py, repo/packcache.py):
+        # cache decisions and moved bytes. A "hit" is any request
+        # served without its own store round trip — an LRU hit or a
+        # follower sharing a single-flight leader's in-flight fetch;
+        # the storm drill's GET accounting rides these.
+        self.restore_cache_hits = Counter(
+            "volsync_restore_cache_hits_total",
+            "Pack requests served from the restore PackCache (LRU hit "
+            "or shared single-flight fetch)",
+            registry=self.registry,
+        )
+        self.restore_cache_misses = Counter(
+            "volsync_restore_cache_misses_total",
+            "Pack requests that paid a store GET (single-flight fetch "
+            "leaders)",
+            registry=self.registry,
+        )
+        self.restore_cache_evictions = Counter(
+            "volsync_restore_cache_evictions_total",
+            "Pack bodies evicted from the restore PackCache LRU to "
+            "stay under the byte budget",
+            registry=self.registry,
+        )
+        self.restore_bytes = Counter(
+            "volsync_restore_bytes_total",
+            "Plaintext bytes written to restore destinations by the "
+            "pipelined restore data plane",
+            registry=self.registry,
+        )
         # Continuous GC service (service/gc.py): prune cycles by outcome
         # — "ok" (cycle ran, repo swept), "contended" (another writer
         # held a conflicting lock; normal under load), "fenced" (this

@@ -221,8 +221,11 @@ class LatencyStore:
     the fake-cloud backend for pipeline benchmarks and backpressure
     tests (a MemObjectStore put is ~1 µs; a real store put is tens of
     ms, which is the regime the async upload stage exists for). Also
-    counts ops and tracks the high-water mark of concurrent puts so
-    tests can assert the upload window is honored."""
+    counts ops and tracks high-water marks of concurrent puts/gets so
+    tests can assert the upload window is honored and the restore
+    drills can account store GETs (``pack_gets`` isolates data-pack
+    fetches — the number the single-flight cache bounds). Zero-latency
+    instances double as pure op counters."""
 
     def __init__(self, inner: ObjectStore, *, put_latency: float = 0.0,
                  get_latency: float = 0.0):
@@ -232,7 +235,29 @@ class LatencyStore:
         self.puts = 0
         self.max_concurrent_puts = 0
         self._active_puts = 0
+        self.gets = 0            # get + get_range arrivals
+        self.pack_gets = 0       # ... with a data/ key (any read)
+        self.pack_fetches = 0    # whole-object data/ GETs only — the
+        #                          count the single-flight cache bounds
+        #                          (ranged tree-blob reads excluded)
+        self.max_concurrent_gets = 0
+        self._active_gets = 0
         self._lock = lockcheck.make_lock("objstore.latency")
+
+    def _enter_get(self, key: str, whole: bool = False) -> None:
+        with self._lock:
+            self.gets += 1
+            if key.startswith("data/"):
+                self.pack_gets += 1
+                if whole:
+                    self.pack_fetches += 1
+            self._active_gets += 1
+            self.max_concurrent_gets = max(self.max_concurrent_gets,
+                                           self._active_gets)
+
+    def _exit_get(self) -> None:
+        with self._lock:
+            self._active_gets -= 1
 
     def put(self, key: str, data: bytes) -> None:
         with self._lock:
@@ -254,14 +279,22 @@ class LatencyStore:
         return self.inner.put_if_absent(key, data)
 
     def get(self, key: str) -> bytes:
-        if self.get_latency:
-            time.sleep(self.get_latency)
-        return self.inner.get(key)
+        self._enter_get(key, whole=True)
+        try:
+            if self.get_latency:
+                time.sleep(self.get_latency)
+            return self.inner.get(key)
+        finally:
+            self._exit_get()
 
     def get_range(self, key: str, offset: int, length: int) -> bytes:
-        if self.get_latency:
-            time.sleep(self.get_latency)
-        return self.inner.get_range(key, offset, length)
+        self._enter_get(key)
+        try:
+            if self.get_latency:
+                time.sleep(self.get_latency)
+            return self.inner.get_range(key, offset, length)
+        finally:
+            self._exit_get()
 
     def exists(self, key: str) -> bool:
         return self.inner.exists(key)
