@@ -4,8 +4,8 @@
 
 .PHONY: lint test chaos chaos-concurrent chaos-fleet chaos-restore \
 	static-check bench-index-smoke service-bench-smoke \
-	fleet-bench-smoke restore-bench-smoke trace-smoke session-smoke \
-	clean-lint
+	fleet-bench-smoke restore-bench-smoke syncplan-bench-smoke \
+	trace-smoke session-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105 + VL301 per-file + VL101-VL104
@@ -96,6 +96,15 @@ fleet-bench-smoke:
 # Scale-accurate numbers need the full run: `python bench.py restore`.
 restore-bench-smoke:
 	python bench.py restore --smoke
+
+# Protocol-planner replay at smoke scale (docs/performance.md,
+# "Protocol planner"): three canned workloads (cold full, 1%-churn,
+# high-dedup) measured with the real engines — batched delta scan,
+# real TreeBackup dedup — then scored against the oracle; asserts the
+# planner matches the cheapest protocol per workload (regret <= 1.05)
+# and the bench JSON contract stays runnable.
+syncplan-bench-smoke:
+	python bench.py syncplan --smoke
 
 # Flight-recorder gate (docs/observability.md): a tiny pipelined backup
 # under a tenant-tagged trace must export a Perfetto-loadable

@@ -1260,6 +1260,175 @@ def restore_bench(total_mib: int = 24, get_latency_s: float = 0.04,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def syncplan_bench(smoke: bool = True) -> dict:
+    """Protocol-planner replay: three canned workloads scored against a
+    measured oracle (``bench.py syncplan``).
+
+    Each workload builds real trees, measures the TRUE wire cost of
+    every protocol with the real engines — DELTA through the batched
+    device scan (engine/deltasync.delta_scan_batch), CDC_DEDUP through
+    two real TreeBackup runs against one repository (the second run's
+    dedup stats are the measured hit ratio) — then replays the
+    workload's history into a SyncStatsBook and asks the planner to
+    choose. ``regret_ratio`` is the true cost of the chosen protocol
+    over the true cost of the cheapest (1.0 = planner matched the
+    oracle); the gate is <= 1.05 per workload, asserted here so the
+    smoke target fails loudly on a cost-model regression. All transfer
+    costs are priced against one canned reference link so the replay is
+    deterministic; device terms use the model's own conservative
+    constants.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from volsync_tpu.engine import deltasync, protoplan, syncstats
+    from volsync_tpu.engine.backup import TreeBackup
+    from volsync_tpu.metrics import GLOBAL as METRICS
+    from volsync_tpu.objstore import MemObjectStore
+    from volsync_tpu.repo.repository import Repository
+
+    LINK_BPS = 100.0 * (1 << 20)   # canned reference link: 100 MiB/s
+    LINK_LAT = 0.010               # 10 ms per round trip
+    # Sized so the three workloads land in three different optimal
+    # regimes on the reference link: files big enough that wire bytes
+    # beat round trips when churn/dedup allow it.
+    n_files = 4 if smoke else 8
+    fsize = (4 << 20) if smoke else (8 << 20)
+    rng = np.random.RandomState(0x5EED)
+    # Small chunker so even smoke-sized files span many CDC chunks.
+    chunker = {"min_size": 16 * 1024, "avg_size": 64 * 1024,
+               "max_size": 256 * 1024, "seed": 7}
+    DEV_BPS = {protoplan.FULL_COPY: 0.0,
+               protoplan.DELTA: protoplan.DEVICE_DELTA_BPS,
+               protoplan.CDC_DEDUP: protoplan.DEVICE_CDC_BPS}
+    RT = {protoplan.FULL_COPY: 1, protoplan.DELTA: 2,
+          protoplan.CDC_DEDUP: 2}
+
+    def true_cost(proto: str, wire: float, nbytes: int) -> float:
+        dev = nbytes / DEV_BPS[proto] if DEV_BPS[proto] else 0.0
+        return (wire / LINK_BPS + n_files * RT[proto] * LINK_LAT + dev)
+
+    def measure_cdc(base_files, new_files):
+        """Measured CDC wire bytes for syncing ``new_files`` into a
+        repository that already holds ``base_files``."""
+        with tempfile.TemporaryDirectory() as td:
+            root = Path(td)
+            repo = Repository.init(MemObjectStore(), chunker=chunker)
+            for sub, files in (("base", base_files), ("new", new_files)):
+                d = root / sub
+                d.mkdir()
+                for i, data in enumerate(files):
+                    (d / f"f{i}.bin").write_bytes(data)
+            if base_files:
+                TreeBackup(repo).run(root / "base")
+            _snap, stats = TreeBackup(repo).run(root / "new")
+            blobs = stats.blobs_new + stats.blobs_dedup
+            wire = (stats.bytes_scanned - stats.bytes_dedup
+                    + protoplan.CDC_CHUNK_META_BYTES * blobs)
+            return wire, stats.blobs_dedup, blobs
+
+    def measure_delta(base_files, new_files):
+        """Measured DELTA wire bytes via the batched device scan."""
+        items, sig_cost = [], 0
+        for old, new in zip(base_files, new_files):
+            sig = deltasync.build_file_signature(
+                old, deltasync.pick_block_len(max(len(old), len(new))))
+            geo = deltasync.signature_geometry(len(old), sig.block_len)
+            sig_cost += (geo.sig_bytes
+                         + protoplan.DELTA_OP_OVERHEAD_PER_BLOCK
+                         * geo.n_blocks)
+            items.append((new, sig))
+        literal = 0
+        ratios = []
+        for (new, sig), ops in zip(items,
+                                   deltasync.delta_scan_batch(items)):
+            lit = deltasync.delta_stats(ops, sig.block_len)["literal_bytes"]
+            literal += lit
+            ratios.append((lit, len(new)))
+        return sig_cost + literal, ratios
+
+    def replay_and_decide(book, *, basis_exists: bool):
+        """One planner decision per (homogeneous) file; every file must
+        agree, so the workload verdict is the per-file verdict."""
+        chosen = {
+            protoplan.decide(fsize, book.snapshot(),
+                             basis_exists=basis_exists).protocol
+            for _ in range(n_files)}
+        assert len(chosen) == 1, f"unstable decisions: {chosen}"
+        return chosen.pop()
+
+    workloads: dict = {}
+
+    # -- workload 1: cold full copy (fresh dest, zero history) ---------
+    new = [rng.bytes(fsize) for _ in range(n_files)]
+    total = n_files * fsize
+    cdc_wire, _hits, _blobs = measure_cdc([], new)
+    costs = {protoplan.FULL_COPY: true_cost("full", total, total),
+             protoplan.CDC_DEDUP: true_cost("cdc", cdc_wire, total)}
+    book = syncstats.SyncStatsBook()
+    workloads["cold_full"] = (costs,
+                              replay_and_decide(book, basis_exists=False))
+
+    # -- workload 2: 1%-churn incremental (delta territory) ------------
+    base = [rng.bytes(fsize) for _ in range(n_files)]
+    new = []
+    for data in base:
+        buf = bytearray(data)
+        for _ in range(4):  # ~1% of bytes across 4 scattered spots
+            at = int(rng.randint(0, fsize - fsize // 400))
+            buf[at:at + fsize // 400] = rng.bytes(fsize // 400)
+        new.append(bytes(buf))
+    delta_wire, ratios = measure_delta(base, new)
+    cdc_wire, hits, blobs = measure_cdc(base, new)
+    costs = {protoplan.FULL_COPY: true_cost("full", total, total),
+             protoplan.DELTA: true_cost("delta", delta_wire, total),
+             protoplan.CDC_DEDUP: true_cost("cdc", cdc_wire, total)}
+    book = syncstats.SyncStatsBook()
+    for lit, nbytes in ratios:        # replay: prior delta runs
+        book.observe_delta(lit, nbytes)
+    book.observe_dedup(hits, blobs)   # ... and the measured dedup rate
+    book.observe_link(total, total / LINK_BPS)
+    book.observe_rtt(LINK_LAT)
+    workloads["churn_1pct"] = (costs,
+                               replay_and_decide(book, basis_exists=True))
+
+    # -- workload 3: high-dedup re-ingest (cdc territory) --------------
+    # same content under new names: no per-file basis for delta, but
+    # nearly every chunk already lives in the repository
+    new = list(base)
+    cdc_wire, hits, blobs = measure_cdc(base, new)
+    costs = {protoplan.FULL_COPY: true_cost("full", total, total),
+             protoplan.CDC_DEDUP: true_cost("cdc", cdc_wire, total)}
+    book = syncstats.SyncStatsBook()
+    book.observe_dedup(hits, blobs)
+    book.observe_link(total, total / LINK_BPS)
+    book.observe_rtt(LINK_LAT)
+    workloads["high_dedup"] = (costs,
+                               replay_and_decide(book, basis_exists=False))
+
+    out: dict = {"bench": "syncplan", "smoke": smoke,
+                 "link": {"bandwidth_bps": LINK_BPS, "latency_s": LINK_LAT},
+                 "files": n_files, "file_bytes": fsize, "workloads": {}}
+    worst = 0.0
+    for name, (costs, chosen) in workloads.items():
+        oracle = min(costs, key=costs.get)
+        regret = costs[chosen] / costs[oracle]
+        worst = max(worst, regret)
+        out["workloads"][name] = {
+            "chosen": chosen, "oracle": oracle,
+            "regret_ratio": round(regret, 4),
+            "cost_s": {p: round(c, 6) for p, c in costs.items()},
+        }
+        assert regret <= 1.05, (
+            f"workload {name}: planner chose {chosen} "
+            f"(regret {regret:.3f}) over oracle {oracle}")
+    out["regret_ratio_max"] = round(worst, 4)
+    METRICS.plan_regret.set(worst)
+    out["provenance"] = bench_provenance(extra={
+        "syncplan": {"files": n_files, "file_bytes": fsize}})
+    return out
+
+
 def _pipeline_child(timeout_s: int = 180):
     """Run ``bench.py pipeline`` in a killable CPU-pinned subprocess and
     parse its JSON line; None on any failure (the main metric must
@@ -1395,6 +1564,11 @@ def main():
                 return 2
         _emit(restore_bench(total_mib=6 if smoke else 24,
                             storm=storm, smoke=smoke))
+        return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "syncplan":
+        # Protocol-planner replay: host + CPU device kernels only.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _emit(syncplan_bench(smoke="--smoke" in sys.argv[2:]))
         return 0
     if len(sys.argv) > 1 and sys.argv[1] == "index":
         # Metadata-plane microbench; host-side only (numpy, no device).

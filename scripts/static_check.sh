@@ -53,6 +53,12 @@
 #      serial vs pipelined vs storm over the 40 ms fake store; keeps
 #      the restore data plane's JSON contract runnable
 #      (docs/performance.md, "Restore data plane").
+#  12. The protocol-planner replay at smoke scale
+#      (`make syncplan-bench-smoke`): three canned workloads measured
+#      with the real engines and scored against the oracle — the
+#      planner must match the cheapest protocol on each (regret
+#      <= 1.05) and the JSON contract must hold
+#      (docs/performance.md, "Protocol planner").
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -93,5 +99,8 @@ make --no-print-directory chaos-restore
 
 echo "== restore-bench-smoke =="
 make --no-print-directory restore-bench-smoke > /dev/null
+
+echo "== syncplan-bench-smoke =="
+make --no-print-directory syncplan-bench-smoke > /dev/null
 
 echo "static_check: OK"

@@ -11,8 +11,10 @@ from volsync_tpu.engine.chunker import (
     params_from_config,
     stream_chunks,
 )
+from volsync_tpu.engine.protoplan import PlanDecision, decide
 from volsync_tpu.engine.restore import TreeRestore, restore_snapshot
 from volsync_tpu.engine.restorepipe import RestoreGroup
+from volsync_tpu.engine.syncstats import SyncStatsBook, book_for
 
 __all__ = [
     "TreeBackup",
@@ -22,4 +24,8 @@ __all__ = [
     "DeviceChunkHasher",
     "stream_chunks",
     "params_from_config",
+    "PlanDecision",
+    "decide",
+    "SyncStatsBook",
+    "book_for",
 ]

@@ -85,11 +85,22 @@ def _load_parent_files(repo: Repository, parent_tree: str,
 
 class TreeBackup:
     def __init__(self, repo: Repository, *, skip_if_empty: bool = True,
-                 hasher=None, workers: Optional[int] = None):
+                 hasher=None, workers: Optional[int] = None,
+                 protocol: str = "cdc"):
         """``hasher`` swaps the chunk+hash engine: single-chip
         DeviceChunkHasher (default) or the mesh-sharded
         parallel.sharded_chunker.MeshChunkHasher — both produce
         bit-identical chunks/ids, so snapshots are interchangeable.
+
+        ``protocol`` selects how file CONTENT is stored: ``"cdc"``
+        (default, the restic-equivalent content-defined chunking),
+        ``"full"`` (whole-file blobs — no sub-file dedup, but no chunk
+        scan either; files above envflags.plan_full_blob_cap() still
+        chunk, the planner's ``size_cap`` rule), or ``"auto"`` (the
+        cost-model planner prices full vs cdc per file against the
+        "restic" SyncStatsBook — engine/protoplan.py). All three
+        produce valid interchangeable snapshots; they differ only in
+        blob granularity, i.e. dedup ratio vs scan cost.
 
         ``workers`` hashes that many FILES concurrently (default 4, env
         VOLSYNC_BACKUP_WORKERS). Files are independent streams, so their
@@ -120,6 +131,9 @@ class TreeBackup:
         if not getattr(self.hasher, "thread_safe", False):
             workers = 1
         self.workers = max(1, workers)
+        if protocol not in ("cdc", "full", "auto"):
+            raise ValueError(f"unknown backup protocol {protocol!r}")
+        self.protocol = protocol
 
     def run(self, root, *, hostname: str = "volsync",
             tags: Optional[list] = None,
@@ -166,9 +180,16 @@ class TreeBackup:
             if self.workers > 1 and len(jobs) > 1:
                 from concurrent.futures import ThreadPoolExecutor
 
+                from volsync_tpu.obs import carry_context
+
+                # carry_context: worker-thread spans (plan.decide when
+                # protocol="auto", repo store spans) keep the caller's
+                # tenant/trace context instead of starting orphaned.
                 with ThreadPoolExecutor(self.workers) as pool:
                     for rel, resolved in pool.map(
-                            lambda j: self._hash_file(*j, stats), jobs):
+                            carry_context(
+                                lambda j: self._hash_file(*j, stats)),
+                            jobs):
                         contents[rel] = resolved
             else:
                 for j in jobs:
@@ -362,7 +383,7 @@ class TreeBackup:
         describe the content that was stored, not the walk-time stat.
         Per-blob stats are updated by the repository under its lock;
         everything else was counted in the walk."""
-        if st.st_size <= self.params.min_size:
+        if st.st_size <= self.params.min_size or self._wants_full(st.st_size):
             data = path.read_bytes()
             digest = blobid.blob_id(data)
             self.repo.add_blob(BLOB_DATA, digest, data, stats)
@@ -392,6 +413,24 @@ class TreeBackup:
         except OSError:  # deleted mid-backup: keep the walk-time stamp
             mtime_ns = st.st_mtime_ns
         return rel, (content, hashed, mtime_ns)
+
+    def _wants_full(self, size: int) -> bool:
+        """Whole-file blob storage for this file? Pinned ``"full"`` says
+        yes up to the blob cap; ``"auto"`` asks the planner (which
+        applies the same cap as its ``size_cap`` rule); ``"cdc"`` never.
+        """
+        if self.protocol == "cdc":
+            return False
+        cap = envflags.plan_full_blob_cap()
+        if self.protocol == "auto":
+            from volsync_tpu.movers import common
+
+            proto = common.plan_protocol(
+                "restic", size, candidates=("full", "cdc"),
+                full_cap=cap).protocol
+        else:
+            proto = self.protocol
+        return proto == "full" and size <= cap
 
     @staticmethod
     def _open_stream(path: Path):

@@ -24,11 +24,22 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from volsync_tpu.ops.delta import build_signature, match_offsets, verify_candidates
+from volsync_tpu.ops.delta import (
+    build_signature,
+    match_offsets,
+    match_offsets_batch,
+    verify_candidates,
+    verify_candidates_batch,
+)
 from volsync_tpu.ops.rolling import weak_checksum_host
 
 MIN_BLOCK = 4096
 MAX_BLOCK = 128 * 1024
+
+#: Wire cost of one signature block: weak32 + 16-byte MD5 (to_wire).
+SIG_BYTES_PER_BLOCK = 4 + 16
+#: Wire cost of a signature's fixed fields (size + block_len ints).
+SIG_HEADER_BYTES = 16
 
 
 def pick_block_len(size: int) -> int:
@@ -40,6 +51,31 @@ def pick_block_len(size: int) -> int:
     while b < target and b < MAX_BLOCK:
         b *= 2
     return b
+
+
+@dataclasses.dataclass(frozen=True)
+class SigGeometry:
+    """The block geometry the engine would pick for a file of ``size``
+    bytes, plus the exact signature wire cost that geometry implies.
+    This is the pricing seam the protocol planner (engine/protoplan.py)
+    uses: DELTA's first round trip ships ``sig_bytes`` for real, so the
+    estimate must come from here, not a re-derived approximation."""
+
+    block_len: int
+    n_blocks: int      # includes the short tail block, matching to_wire
+    sig_bytes: int
+
+
+def signature_geometry(size: int,
+                       block_len: Optional[int] = None) -> SigGeometry:
+    """Geometry + signature wire size for a ``size``-byte destination
+    file (``block_len`` overrides the heuristic, as build_file_signature
+    allows)."""
+    block_len = block_len or pick_block_len(size)
+    n_blocks = 0 if size <= 0 else -(-size // block_len)
+    return SigGeometry(block_len=block_len, n_blocks=n_blocks,
+                       sig_bytes=SIG_HEADER_BYTES
+                       + n_blocks * SIG_BYTES_PER_BLOCK)
 
 
 @dataclasses.dataclass
@@ -133,10 +169,22 @@ def compute_delta(src: bytes, sig: FileSignature) -> list[Op]:
     strongs = verify_candidates(dev, cand, block_len=block_len)
     strong_bytes = [strongs[i].astype("<u4").tobytes()
                     for i in range(len(cand))]
+    return _select_ops(src, arr, sig, full_weak, cand, strong_bytes)
+
+
+def _select_ops(src: bytes, arr: np.ndarray, sig: FileSignature,
+                full_weak: np.ndarray, cand, strong_bytes: list) -> list[Op]:
+    """Host-side tail of the delta scan, shared verbatim by the serial
+    and batched paths (byte-identity between them reduces to the device
+    stages producing the same candidate set): map verified candidates
+    to destination blocks, then greedy left-to-right op selection over
+    the sparse matches."""
+    L = len(src)
+    block_len = sig.block_len
     # weak -> destination block ids (handle duplicate weak values)
     by_weak: dict[int, list[int]] = {}
-    for orig_idx in sort_idx:
-        by_weak.setdefault(int(full_weak[orig_idx]), []).append(int(orig_idx))
+    for orig_idx in range(len(full_weak)):
+        by_weak.setdefault(int(full_weak[orig_idx]), []).append(orig_idx)
     # offset -> destination block index for verified matches
     verified: dict[int, int] = {}
     weak_at = _weak_at_offsets(arr, cand, block_len)
@@ -201,6 +249,92 @@ def _with_tail_match(src: bytes, sig: FileSignature,
             ops.append(("data", remainder))
         ops.append(("copy", n_full, 1))
     return ops
+
+
+def delta_scan_batch(items) -> list[list[Op]]:
+    """Multi-file delta scan: the device stages of ``compute_delta``
+    (rolling weak scan -> signature membership -> batched MD5 verify)
+    run once per GROUP of files instead of once per file.
+
+    ``items`` is a sequence of ``(src_bytes, FileSignature)`` pairs;
+    returns one op stream per item, byte-identical to calling
+    ``compute_delta`` on each (the golden oracle —
+    tests/test_delta_batch.py): the host-side greedy selection is the
+    shared ``_select_ops``, and the batched kernels produce the same
+    per-file candidate sets because padding rows to a common bucketed
+    length only adds scan offsets that the per-row valid-length mask
+    discards.
+
+    Files are grouped by block length (pick_block_len emits few distinct
+    pow2 values) and each group is padded to a bucket-rounded row length
+    (engine/chunker._buffer_bucket), so jit cache entries stay bounded
+    exactly like the CDC path's segment buffers. Host-only short
+    circuits (empty files, sub-block files, signatures with no full
+    block) never reach the device — same as the serial engine.
+    """
+    import jax.numpy as jnp
+
+    from volsync_tpu.engine.chunker import _buffer_bucket
+
+    results: list = [None] * len(items)
+    groups: dict[int, list[int]] = {}
+    for i, (src, sig) in enumerate(items):
+        if len(src) == 0:
+            results[i] = []
+            continue
+        n_full_dst = sig.size // sig.block_len
+        if n_full_dst == 0 or len(src) < sig.block_len:
+            results[i] = _with_tail_match(src, sig, [("data", src)])
+            continue
+        groups.setdefault(sig.block_len, []).append(i)
+
+    for block_len, idxs in groups.items():
+        arrs = [np.frombuffer(items[i][0], np.uint8) for i in idxs]
+        lens = [len(a) for a in arrs]
+        L = _buffer_bucket(max(lens))
+        n = len(idxs)
+        data = np.zeros((n, L), np.uint8)
+        for r, a in enumerate(arrs):
+            data[r, : len(a)] = a
+        full_weaks = [items[i][1].weak[: items[i][1].size // block_len]
+                      for i in idxs]
+        nb = np.array([len(w) for w in full_weaks], np.int32)
+        nb_cap = _pow2ceil(int(nb.max()))
+        sorted_weak = np.full((n, nb_cap), 0xFFFFFFFF, np.uint32)
+        for r, w in enumerate(full_weaks):
+            sorted_weak[r, : len(w)] = np.sort(w, kind="stable")
+        nscan = np.array([ln - block_len + 1 for ln in lens], np.int32)
+        width = L - block_len + 1
+        dev = jnp.asarray(data)
+        sw_dev = jnp.asarray(sorted_weak)
+        nb_dev = jnp.asarray(nb)
+        ns_dev = jnp.asarray(nscan)
+        cap = max(1024, _pow2ceil(sum(ln // block_len for ln in lens) * 4))
+        while True:
+            cand_dev, count = match_offsets_batch(
+                dev, sw_dev, nb_dev, ns_dev, window=block_len,
+                max_candidates=cap)
+            total = int(count)
+            if total <= cap:
+                flat = np.asarray(cand_dev)[:total]
+                break
+            cap = _pow2ceil(total)
+        rows = flat // width
+        offs = flat % width
+        states = verify_candidates_batch(dev, rows, offs,
+                                         block_len=block_len)
+        strong_all = [states[k].astype("<u4").tobytes()
+                      for k in range(len(flat))]
+        for r, i in enumerate(idxs):
+            picks = np.nonzero(rows == r)[0]
+            src, sig = items[i]
+            if len(picks) == 0:
+                results[i] = _with_tail_match(src, sig, [("data", src)])
+                continue
+            results[i] = _select_ops(
+                src, arrs[r], sig, full_weaks[r], offs[picks],
+                [strong_all[k] for k in picks])
+    return results
 
 
 def apply_delta(ops: list[Op], dest: bytes, block_len: int) -> bytes:

@@ -450,6 +450,42 @@ def session_status_path() -> Optional[str]:
     return env_str("VOLSYNC_SESSION_STATUS")
 
 
+# -- sync-protocol planner knobs (engine/protoplan.py, syncstats.py) ------
+
+def sync_protocol() -> str:
+    """VOLSYNC_SYNC_PROTO: per-call override of the adaptive protocol
+    planner — ``auto`` (cost model decides), ``full`` (whole-file copy),
+    ``delta`` (rsync-style signature exchange), ``cdc`` (content-defined
+    chunking + dedup). Unknown values degrade to ``auto`` (a typo'd
+    override must not wedge a sync into a nonexistent protocol)."""
+    raw = (env_str("VOLSYNC_SYNC_PROTO") or "auto").strip().lower()
+    return raw if raw in ("auto", "full", "delta", "cdc") else "auto"
+
+
+def plan_ewma_alpha() -> float:
+    """VOLSYNC_PLAN_EWMA: smoothing factor for the SyncStatsBook's
+    exponentially weighted moving averages (change rate, dedup ratio,
+    link bandwidth/latency). Clamped to (0, 1]: 1.0 = last sample only."""
+    v = env_float("VOLSYNC_PLAN_EWMA", 0.3, minimum=0.0)
+    return min(max(v, 0.01), 1.0)
+
+
+def delta_batch_files() -> int:
+    """VOLSYNC_DELTA_BATCH: how many files the rsync source coalesces
+    into one batched signature round trip + one device delta-scan
+    dispatch ladder (engine/deltasync.delta_scan_batch); 1 = the serial
+    per-file path."""
+    return env_int("VOLSYNC_DELTA_BATCH", 32, minimum=1)
+
+
+def plan_full_blob_cap() -> int:
+    """VOLSYNC_PLAN_FULL_CAP: largest file (bytes) the planner may store
+    as a single whole-file blob on the CDC side's FULL_COPY path; larger
+    files always chunk (a monolithic blob past the segment bucket
+    ceiling would blow pack sizing and device call shapes)."""
+    return env_int("VOLSYNC_PLAN_FULL_CAP", 8 * 1024 * 1024, minimum=4096)
+
+
 # -- resilience layer knobs (resilience.py) ------------------------------
 
 def retry_attempts() -> int:

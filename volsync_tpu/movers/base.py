@@ -43,6 +43,8 @@ class Result:
 __all__ = [
     "Mover", "Builder", "Catalog", "CATALOG", "Result",
     "NoMoverFound", "MultipleMoversFound",
+    "PROTO_AUTO", "PROTO_FULL", "PROTO_DELTA", "PROTO_CDC",
+    "SYNC_PROTOCOLS", "normalize_protocol",
     "EV_TRANSFER_STARTED", "EV_TRANSFER_FAILED", "EV_TRANSFER_COMPLETED",
     "EV_PVC_CREATED",
     "EV_PVC_NOT_BOUND", "EV_SNAP_CREATED", "EV_SNAP_NOT_BOUND",
@@ -50,6 +52,26 @@ __all__ = [
     "ACT_CREATING", "ACT_WAITING",
     "SNAP_BIND_TIMEOUT", "VOLUME_BIND_TIMEOUT", "SERVICE_ADDRESS_TIMEOUT",
 ]
+
+
+# Sync-protocol selection vocabulary shared by every mover. "auto"
+# delegates the per-file choice to the cost-model planner
+# (engine/protoplan.py); the rest pin it. Matches the protocol names in
+# protoplan.PROTOCOLS plus the planner-delegating sentinel.
+PROTO_AUTO = "auto"
+PROTO_FULL = "full"
+PROTO_DELTA = "delta"
+PROTO_CDC = "cdc"
+SYNC_PROTOCOLS = (PROTO_AUTO, PROTO_FULL, PROTO_DELTA, PROTO_CDC)
+
+
+def normalize_protocol(value, default: str = PROTO_AUTO) -> str:
+    """Validate a mover's requested sync protocol; unknown or empty
+    degrades to ``default`` (the same degrade-don't-raise contract as
+    envflags.sync_protocol())."""
+    if isinstance(value, str) and value.strip().lower() in SYNC_PROTOCOLS:
+        return value.strip().lower()
+    return default
 
 
 class Mover(Protocol):

@@ -21,6 +21,30 @@ from volsync_tpu.movers.base import Result
 TRANSFER_RECORDED_ANNOTATION = "volsync.backube/transfer-recorded"
 
 
+def plan_protocol(mover: str, size: int, *, basis_exists: bool = True,
+                  candidates=None, full_cap=None, block_len=None):
+    """One-stop planner call for a mover data plane: refresh the mover's
+    ``SyncStatsBook`` from its live feeds (ResilientStore link timings,
+    dedup-index counters), then price and decide for one ``size``-byte
+    file. Movers always allow probe runs — they are the parties that CAN
+    run the fancier protocol, so they must be the ones seeding an empty
+    book (protoplan's cold-start contract).
+
+    Returns the full ``protoplan.PlanDecision`` (``.protocol`` is the
+    verdict; losing scores stay attached for the caller's telemetry).
+    """
+    from volsync_tpu.engine import protoplan, syncstats
+
+    book = syncstats.book_for(mover)
+    book.pull_link_timings()
+    book.pull_index_metrics()
+    kwargs = {"basis_exists": basis_exists, "allow_probe": True,
+              "full_cap": full_cap, "block_len": block_len}
+    if candidates is not None:
+        kwargs["candidates"] = candidates
+    return protoplan.decide(size, book.snapshot(), **kwargs)
+
+
 def mover_name(prefix: str, owner) -> str:
     return f"volsync-{prefix}-{owner.metadata.name}"
 

@@ -155,9 +155,19 @@ def _dispatch(ctx, env: dict, direction: str) -> int:
         t0 = time.perf_counter()
         from volsync_tpu.obs import device_trace, span
 
+        from volsync_tpu.movers.base import normalize_protocol
+
+        # SYNC_PROTOCOL=auto delegates per-file full-vs-cdc storage to
+        # the cost-model planner (engine/protoplan.py); default stays
+        # the reference-equivalent CDC chunking. "delta" makes no sense
+        # against a dedup repository and degrades to the default.
+        proto = normalize_protocol(env.get("SYNC_PROTOCOL"), default="cdc")
+        if proto == "delta":
+            proto = "cdc"
         with device_trace("restic-backup"), span("mover.restic.backup"):
             snap_id, stats = TreeBackup(
-                repo, hasher=_select_hasher(env, repo)).run(
+                repo, hasher=_select_hasher(env, repo),
+                protocol=proto).run(
                 data, hostname=env.get("HOSTNAME", "volsync"))
         log.info("backup snapshot=%s stats=%s", snap_id, stats.as_dict())
         ctx.report_transfer(stats.bytes_scanned, time.perf_counter() - t0)

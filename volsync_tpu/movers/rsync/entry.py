@@ -167,7 +167,14 @@ def _dest_verbs(root: Path):
                     removed += 1
         return {"verb": "ok", "removed": removed}
 
-    return {"sig": sig, "apply": apply, "mkdir": mkdir,
+    def sigs(msg):
+        """Batched ``sig``: one round trip for a whole file batch — the
+        round-trip half of the planner's DELTA wire cost (protoplan's
+        rt=2 is per BATCH now, which is what makes delta worth pricing
+        on high-latency links)."""
+        return {"verb": "sigs", "sigs": [sig(item) for item in msg["files"]]}
+
+    return {"sig": sig, "sigs": sigs, "apply": apply, "mkdir": mkdir,
             "symlink": symlink, "link": link, "special": special,
             "dirmeta": dirmeta, "prune": prune}
 
@@ -319,10 +326,22 @@ def _meta_of(st, p=None) -> dict:
 
 
 def _push_tree(ch, root: Path) -> dict:
+    from volsync_tpu import envflags
+
     stats = {"files": 0, "literal_bytes": 0, "copied_bytes": 0, "bytes": 0}
     keep: list[str] = []
     dirmeta: list[dict] = []
     inode_first: dict = {}  # (dev, ino) -> rel (rsync -H)
+    # Regular files accumulate into planner-driven batches (one sig
+    # round trip + one device dispatch ladder per batch); VOLSYNC_DELTA_BATCH=1
+    # keeps the legacy serial per-file path.
+    batch_n = envflags.delta_batch_files()
+    pending: list[tuple] = []
+
+    def flush():
+        if pending:
+            _push_files_batch(ch, pending, stats)
+            pending.clear()
     # rsync -x: one file system. stat(), not lstat(): a SYMLINKED
     # replication root (mount indirection) must anchor the device id at
     # the walk's actual filesystem, or every entry looks foreign and
@@ -356,13 +375,21 @@ def _push_tree(ch, root: Path) -> dict:
                     ino = (st.st_dev, st.st_ino)
                     first = inode_first.get(ino)
                     if first is not None:
+                        # the link target must already exist at the
+                        # destination — drain any batch holding it
+                        flush()
                         ch.send({"verb": "link", "path": rel,
                                  "to": first})
                         ch.recv()
                         stats["files"] += 1
                         continue
                     inode_first[ino] = rel
-                _push_file(ch, p, rel, st, stats)
+                if batch_n <= 1:
+                    _push_file(ch, p, rel, st, stats)
+                else:
+                    pending.append((p, rel, st))
+                    if len(pending) >= batch_n:
+                        flush()
             elif stat_mod.S_ISFIFO(st.st_mode) or stat_mod.S_ISSOCK(
                     st.st_mode) or stat_mod.S_ISBLK(st.st_mode) \
                     or stat_mod.S_ISCHR(st.st_mode):
@@ -374,6 +401,7 @@ def _push_tree(ch, root: Path) -> dict:
                     msg["rdev"] = st.st_rdev
                 ch.send(msg)
                 ch.recv()
+    flush()
     ch.send({"verb": "prune", "paths": keep})
     ch.recv()
     # Directory metadata last, children-first (deepest paths first),
@@ -409,6 +437,75 @@ def _push_file(ch, path: Path, rel: str, st, stats: dict):
     stats["bytes"] += len(data)
     stats["literal_bytes"] += d["literal_bytes"]
     stats["copied_bytes"] += d["copied_bytes"]
+
+
+def _push_files_batch(ch, jobs: list, stats: dict):
+    """Planner-driven batch push: price FULL vs DELTA per file
+    (movers.common.plan_protocol -> engine/protoplan), fetch signatures
+    for all delta-planned files in ONE ``sigs`` round trip, run the
+    delta scan for the whole batch through ONE device dispatch ladder
+    (deltasync.delta_scan_batch), then apply per file. Every completed
+    delta and timed round trip feeds the rsync ``SyncStatsBook``, so the
+    planner's next batch prices against what this one actually cost."""
+    from volsync_tpu.engine.syncstats import book_for
+    from volsync_tpu.movers import common
+
+    book = book_for("rsync")
+    datas = [p.read_bytes() for p, _rel, _st in jobs]
+    plans = []
+    for (p, rel, st), data in zip(jobs, datas):
+        block_len = deltasync.pick_block_len(max(len(data), st.st_size))
+        decision = common.plan_protocol(
+            "rsync", len(data), candidates=("full", "delta"),
+            block_len=block_len)
+        plans.append((decision.protocol, block_len))
+    want = [i for i, (proto, _bl) in enumerate(plans) if proto == "delta"]
+    sig_by_idx: dict = {}
+    if want:
+        # NOT timed as a latency sample: the reply embeds the
+        # destination's signature computation (and, first time, its jit
+        # compile), which would poison the rtt EWMA by orders of
+        # magnitude. Small apply acks below are the latency proxy.
+        ch.send({"verb": "sigs", "files": [
+            {"path": jobs[i][1], "block_len": plans[i][1]} for i in want]})
+        reply = ch.recv()
+        for i, r in zip(want, reply["sigs"]):
+            if r.get("exists"):
+                sig_by_idx[i] = deltasync.FileSignature.from_wire(r)
+    scanned = [i for i in want if i in sig_by_idx]
+    batch_ops = deltasync.delta_scan_batch(
+        [(datas[i], sig_by_idx[i]) for i in scanned]) if scanned else []
+    ops_by_idx = dict(zip(scanned, batch_ops))
+    for idx, ((p, rel, st), data) in enumerate(zip(jobs, datas)):
+        _proto, block_len = plans[idx]
+        if idx in ops_by_idx:
+            ops = ops_by_idx[idx]
+            block_len = sig_by_idx[idx].block_len
+        else:
+            # planner said FULL, or the destination has no basis: the
+            # whole file ships as one literal op (still delta framing)
+            ops = [("data", data)] if data else []
+        wire_ops = [list(op) for op in ops]
+        t0 = time.perf_counter()
+        ch.send({"verb": "apply", "path": rel, "ops": wire_ops,
+                 "block_len": block_len, **_meta_of(st, p)})
+        out = ch.recv()
+        elapsed = time.perf_counter() - t0
+        if out.get("verb") != "ok":
+            raise channel.ChannelError(f"apply failed for {rel}: {out}")
+        d = deltasync.delta_stats(ops, block_len)
+        if idx in ops_by_idx:
+            book.observe_delta(d["literal_bytes"], len(data))
+        # same small/large split as resilience.link_totals(): bulk
+        # applies sample bandwidth, near-empty ones sample latency
+        if d["literal_bytes"] >= 16 * 1024:
+            book.observe_link(d["literal_bytes"], elapsed)
+        else:
+            book.observe_rtt(elapsed)
+        stats["files"] += 1
+        stats["bytes"] += len(data)
+        stats["literal_bytes"] += d["literal_bytes"]
+        stats["copied_bytes"] += d["copied_bytes"]
 
 
 # ---------------------------------------------------------------------------

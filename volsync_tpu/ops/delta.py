@@ -85,3 +85,73 @@ def verify_candidates(data: jax.Array, cand: np.ndarray, *,
         return np.zeros((0, 4), dtype=np.uint32)
     starts = jnp.asarray(np.asarray(cand, dtype=np.int32))
     return np.asarray(md5_fixed_blocks_device(data, starts, block_len=block_len))
+
+
+_M16 = np.uint32(0xFFFF)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "max_candidates"))
+def match_offsets_batch(data: jax.Array, sorted_weak: jax.Array,
+                        nb: jax.Array, nscan: jax.Array, *,
+                        window: int, max_candidates: int):
+    """Multi-file ``match_offsets``: one rolling scan + membership pass
+    over a whole padded file batch (engine/deltasync.delta_scan_batch).
+
+    data:        [n, L] uint8, one zero-padded file per row.
+    sorted_weak: [n, nb_cap] uint32 per-row sorted signature weak sets,
+                 0xFFFFFFFF-padded past each row's true count.
+    nb:          [n] int32 true signature lengths (masks the padding —
+                 a real weak equal to the sentinel still matches inside
+                 its row's first ``nb`` entries, exactly like the serial
+                 clip-then-compare).
+    nscan:       [n] int32 valid scan offsets per row (len - window + 1);
+                 offsets whose window would read padding are masked out,
+                 which is what makes the batch candidate set per row
+                 identical to the serial per-file scan.
+
+    Returns (cand [max_candidates] int32 ascending row-major flattened
+    indices into [n, L-window+1] with n*(L-window+1) as fill,
+    true_count) — the host re-runs with a doubled bound on truncation,
+    same ladder as the serial path.
+    """
+    n, L = data.shape
+    width = L - window + 1
+    # Rolling weak checksum of every row at every offset, batched: the
+    # same prefix-sum identity as ops/rolling.py with cumsums along the
+    # row axis (uint32 wraparound keeps the mod-2^16 residues exact).
+    x = data.astype(jnp.uint32)
+    j = jnp.arange(L, dtype=jnp.uint32)[None, :]
+    S = jnp.pad(jnp.cumsum(x, axis=1, dtype=jnp.uint32), ((0, 0), (1, 0)))
+    T = jnp.pad(jnp.cumsum(j * x, axis=1, dtype=jnp.uint32), ((0, 0), (1, 0)))
+    k = jnp.arange(width, dtype=jnp.uint32)[None, :]
+    dS = S[:, window:] - S[:, :width]
+    dT = T[:, window:] - T[:, :width]
+    a = dS & _M16
+    b = ((k + np.uint32(window)) * dS - dT) & _M16
+    weak = a | (b << np.uint32(16))                      # [n, width]
+    # Per-row membership against that row's sorted signature.
+    pos = jax.vmap(jnp.searchsorted)(sorted_weak, weak)  # [n, width]
+    clipped = jnp.minimum(pos, sorted_weak.shape[1] - 1)
+    found = jnp.take_along_axis(sorted_weak, clipped, axis=1)
+    hit = (found == weak) & (pos < nb[:, None])
+    hit = hit & (jnp.arange(width, dtype=jnp.int32)[None, :]
+                 < nscan[:, None])
+    flat = hit.reshape(-1)
+    cand = jnp.nonzero(flat, size=max_candidates, fill_value=n * width)[0]
+    return cand.astype(jnp.int32), jnp.sum(hit)
+
+
+def verify_candidates_batch(data: jax.Array, rows: np.ndarray,
+                            offs: np.ndarray, *,
+                            block_len: int) -> np.ndarray:
+    """Batch MD5 over candidate windows across a padded [n, L] file
+    batch -> [k, 4] uint32 states. One dispatch for the whole batch:
+    rows flatten to offsets into the [n*L] buffer, and a candidate
+    window never crosses a row boundary (offs <= row_len - block_len)."""
+    if len(rows) == 0:
+        return np.zeros((0, 4), dtype=np.uint32)
+    L = data.shape[1]
+    starts = (np.asarray(rows, dtype=np.int64) * L
+              + np.asarray(offs, dtype=np.int64)).astype(np.int32)
+    return np.asarray(md5_fixed_blocks_device(
+        data.reshape(-1), jnp.asarray(starts), block_len=block_len))

@@ -395,6 +395,56 @@ def reset_breakers():
         _breakers.clear()
 
 
+# -- measured link statistics ----------------------------------------------
+
+#: Payload size below which a store op is treated as a latency probe
+#: rather than a bandwidth sample: tiny transfers are dominated by the
+#: per-request round trip, so their wall time estimates link latency,
+#: while large transfers estimate sustained bytes/second.
+_LINK_SMALL_BYTES = 16 * 1024
+
+_link_lock = lockcheck.make_lock("resilience.link")
+_link_totals = {"small_ops": 0, "small_seconds": 0.0,
+                "large_ops": 0, "large_bytes": 0, "large_seconds": 0.0}
+
+
+def _observe_link(nbytes: int, seconds: float) -> None:
+    """Fold one successful store attempt into the cumulative link
+    totals (only arithmetic under the lock)."""
+    with _link_lock:
+        if nbytes < _LINK_SMALL_BYTES:
+            _link_totals["small_ops"] += 1
+            _link_totals["small_seconds"] += seconds
+        else:
+            _link_totals["large_ops"] += 1
+            _link_totals["large_bytes"] += nbytes
+            _link_totals["large_seconds"] += seconds
+
+
+def link_totals() -> dict:
+    """Cumulative timings of successful byte-moving ResilientStore
+    attempts. The protocol planner's SyncStatsBook
+    (engine/syncstats.py) diffs successive snapshots into EWMA
+    bandwidth/latency estimates; returning cumulative totals keeps any
+    number of independent books consistent."""
+    with _link_lock:
+        return dict(_link_totals)
+
+
+def reset_link_totals() -> None:
+    """Zero the cumulative link totals (tests)."""
+    with _link_lock:
+        for k in _link_totals:
+            _link_totals[k] = type(_link_totals[k])()
+
+
+def _payload_bytes(op: str, args: tuple, kwargs: dict, result) -> int:
+    if op == "put":
+        data = args[1] if len(args) > 1 else kwargs.get("data", b"")
+        return len(data)
+    return len(result) if isinstance(result, (bytes, bytearray)) else 0
+
+
 # -- resilient object-store wrapper ----------------------------------------
 
 #: Store methods wrapped with retry (all idempotent: puts are
@@ -437,9 +487,29 @@ class ResilientStore:
             lambda: list(self.inner.list(prefix))))
 
 
+#: Byte-moving ops whose successful attempts feed the measured link
+#: totals above. put_file/get_file are excluded: sizing them would cost
+#: an extra stat per call on a path that already reports transfer totals
+#: through the pipeline's own accounting.
+_TIMED_OPS = ("put", "get", "get_range")
+
+
 def _make_op(op: str):
-    def method(self, *args, **kwargs):
-        return self.policy.call(getattr(self.inner, op), *args, **kwargs)
+    if op in _TIMED_OPS:
+        def method(self, *args, **kwargs):
+            inner = getattr(self.inner, op)
+
+            def timed(*a, **kw):
+                t0 = time.perf_counter()
+                out = inner(*a, **kw)
+                _observe_link(_payload_bytes(op, a, kw, out),
+                              time.perf_counter() - t0)
+                return out
+
+            return self.policy.call(timed, *args, **kwargs)
+    else:
+        def method(self, *args, **kwargs):
+            return self.policy.call(getattr(self.inner, op), *args, **kwargs)
 
     method.__name__ = op
     return method
