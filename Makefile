@@ -3,9 +3,9 @@
 # tier-1 command in ROADMAP.md.
 
 .PHONY: lint test chaos chaos-concurrent chaos-fleet chaos-restore \
-	static-check bench-index-smoke service-bench-smoke \
-	fleet-bench-smoke restore-bench-smoke syncplan-bench-smoke \
-	trace-smoke session-smoke clean-lint
+	chaos-scrub scrub-smoke static-check bench-index-smoke \
+	service-bench-smoke fleet-bench-smoke restore-bench-smoke \
+	syncplan-bench-smoke trace-smoke session-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105 + VL301 per-file + VL101-VL104
@@ -61,6 +61,24 @@ chaos-fleet:
 # file; plus the golden serial≡pipelined byte-identity suite.
 chaos-restore:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_restore_chaos.py \
+	    tests/test_restorepipe.py -q -m 'not slow' -p no:cacheprovider
+
+# Silent-corruption defense, deterministic half (docs/robustness.md,
+# "Silent corruption & scrub"): ScrubService heal/quarantine/backfill
+# units, the serial≡device check(read_data=True) golden, and the
+# `volsync scrub` exit-code contract — no seeded storms.
+scrub-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_scrub_chaos.py \
+	    -q -m 'not slow' -k "not chaos_" -p no:cacheprovider
+
+# Bit-rot chaos drill (docs/robustness.md, "Silent corruption &
+# scrub"): seeded bitflip schedules corrupt pack GET payloads under a
+# live restore storm + scrub service + ContinuousGC + concurrent
+# backup traffic — every drill ends quarantine-empty, check-clean and
+# byte-identical (no single-copy corruption ever reaches a restored
+# file); plus the read-repair suite riding test_restorepipe.py.
+chaos-scrub:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_scrub_chaos.py \
 	    tests/test_restorepipe.py -q -m 'not slow' -p no:cacheprovider
 
 static-check:

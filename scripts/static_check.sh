@@ -59,6 +59,15 @@
 #      planner must match the cheapest protocol on each (regret
 #      <= 1.05) and the JSON contract must hold
 #      (docs/performance.md, "Protocol planner").
+#  13. The scrub smoke (`make scrub-smoke`): ScrubService
+#      heal/quarantine/backfill units, the serial≡device
+#      check(read_data=True) golden, and the `volsync scrub` exit-code
+#      contract (docs/robustness.md, "Silent corruption & scrub").
+#  14. The bit-rot chaos drill (`make chaos-scrub`): seeded bitflip
+#      schedules under a live restore storm + scrub + ContinuousGC +
+#      concurrent backup — quarantine-empty, check-clean,
+#      byte-identical restores, plus the read-repair suite
+#      (docs/robustness.md, "Silent corruption & scrub").
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -102,5 +111,11 @@ make --no-print-directory restore-bench-smoke > /dev/null
 
 echo "== syncplan-bench-smoke =="
 make --no-print-directory syncplan-bench-smoke > /dev/null
+
+echo "== scrub-smoke =="
+make --no-print-directory scrub-smoke
+
+echo "== chaos-scrub =="
+make --no-print-directory chaos-scrub
 
 echo "static_check: OK"

@@ -542,6 +542,117 @@ def test_fault_partition_parse_spec_and_default_duration():
     assert fs.get("k") == b"v"
 
 
+def test_bitflip_parse_spec_roundtrip():
+    spec = parse_spec("bitflip:p=0.01,op=get,nbytes=3,prefix=data/")[0]
+    assert spec == FaultSpec(kind="bitflip", p=0.01, op="get",
+                             nbytes=3, key_prefix="data/")
+    assert parse_spec("bitflip:at=2")[0].nbytes == 1  # default: one byte
+
+
+def test_bitflip_corrupts_silently_no_exception():
+    """The silent fault class: the get SUCCEEDS, the payload is wrong,
+    the stored object is untouched, and the injection is recorded."""
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=3, specs=[
+                        FaultSpec(kind="bitflip", at=1, op="get")]))
+    fs.put("k", b"0123456789")
+    rotten = fs.get("k")  # no exception — that IS the fault
+    assert rotten != b"0123456789" and len(rotten) == 10
+    # recorded only because corrupted bytes actually reached the caller
+    assert fs.injected == [(2, "get", "k", "bitflip")]
+    assert fs.get("k") == b"0123456789"  # at=1 consumed: clean again
+    assert fs.inner.get("k") == b"0123456789"  # bytes at rest untouched
+
+
+def test_bitflip_deterministic_same_seed():
+    """Same seed, same op sequence => byte-identical corruption (the
+    chaos drills replay exact rot); a different seed rots differently."""
+    def run(seed):
+        fs = FaultStore(MemObjectStore(),
+                        FaultSchedule(seed=seed, specs=[
+                            FaultSpec(kind="bitflip", p=0.5, op="get")]))
+        for i in range(8):
+            fs.put(f"k/{i}", bytes(64))
+        # two reads per key: occurrence number feeds the hash, so the
+        # SAME key may rot on one read and not the other
+        return [fs.get(f"k/{i}") for i in range(8) for _ in range(2)]
+
+    a, b = run(21), run(21)
+    assert a == b
+    assert run(22) != a
+    assert any(r != bytes(64) for r in a)  # some reads rotted
+    assert any(r == bytes(64) for r in a)  # ...and some stayed clean
+
+
+def test_bitflip_nbytes_flips_multiple_positions():
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=5, specs=[
+                        FaultSpec(kind="bitflip", at=1, op="get",
+                                  nbytes=4)]))
+    fs.put("k", bytes(4096))
+    rotten = fs.get("k")
+    diffs = [i for i in range(4096) if rotten[i] != 0]
+    # up to 4 distinct positions (hash collisions may coincide); every
+    # mask has its low bit set, so at least one byte always differs
+    assert 1 <= len(diffs) <= 4
+
+
+def test_bitflip_matches_payload_ops_only():
+    """bitflip exists only on payload-returning reads: a p=1.0 spec
+    never touches puts / exists / size / list (which return non-bytes
+    the corruptor could not even process), but rots every get and
+    get_range."""
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="bitflip", p=1.0)]))
+    fs.put("k", b"abcdef")
+    assert fs.exists("k") is True
+    assert fs.size("k") == 6
+    assert list(fs.list("")) == ["k"]
+    assert fs.get("k") != b"abcdef"
+    assert fs.get_range("k", 1, 3) != b"bcd"
+    assert fs.injected and all(
+        op in ("get", "get_range") and kind == "bitflip"
+        for (_, op, _, kind) in fs.injected)
+
+
+def test_bitflip_counter_frozen_under_partition():
+    """Reads blocked by a partition window never reach the store, so a
+    bitflip spec's at=N read counter must not advance for them — the
+    Nth REAL read still rots after the window heals."""
+    clk = [0.0]
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="partition", at=1, op="get",
+                                  latency=5.0),
+                        FaultSpec(kind="bitflip", at=2, op="get")]),
+                    clock=lambda: clk[0])
+    fs.put("k", b"payload")
+    with pytest.raises(InjectedPartition):
+        fs.get("k")  # read arrival #1: window opens, bitflip count = 1
+    for _ in range(4):  # blocked arrivals: counters frozen
+        with pytest.raises(InjectedPartition):
+            fs.get("k")
+    clk[0] = 6.0
+    assert fs.get("k") != b"payload"  # read arrival #2: bitflip fires
+    assert fs.get("k") == b"payload"
+
+
+def test_bitflip_masked_by_louder_fault_not_recorded():
+    """When a loud spec fires on the same arrival, the op raises and no
+    corrupted payload reaches the caller — so no bitflip is recorded
+    (injected must equal what the caller actually observed)."""
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="bitflip", at=1, op="get"),
+                        FaultSpec(kind="transient", at=1, op="get")]))
+    fs.put("k", b"v")
+    with pytest.raises(FaultInjected):
+        fs.get("k")
+    assert [k for (_, _, _, k) in fs.injected] == ["transient"]
+    assert fs.get("k") == b"v"  # both at=1 counters consumed
+
+
 def test_fault_latency_sleeps(monkeypatch):
     slept = []
     fs = FaultStore(MemObjectStore(),

@@ -284,3 +284,112 @@ def test_pack_cache_oversized_body_not_cached():
     # second read must re-fetch (miss), not corrupt the budget
     assert cache.get_pack(pack_id) == b"z" * 4096
     assert cache.stats()["misses"] == 2
+
+
+# -- read-repair (mirror heal during restore) --------------------------------
+
+class _MirrorCountingStore:
+    """Pass-through shim counting ``mirror/`` GETs — the read-repair
+    contract is ONE mirror fetch per corrupt pack, however many blobs
+    or verify batches that pack spans."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.mirror_gets = 0
+
+    def get(self, key):
+        if key.startswith("mirror/"):
+            self.mirror_gets += 1
+        return self.inner.get(key)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _corrupt_first_file_blob(store):
+    """Flip one payload byte of the pack holding the first file blob;
+    returns (pack_id, pack_key)."""
+    import json
+
+    repo = Repository.open(store)
+    _, manifest = repo.list_snapshots()[0]
+    tree = json.loads(repo.read_blob(manifest["tree"]))
+    blob0 = next(e for e in tree["entries"]
+                 if e["type"] == "file" and e["content"])["content"][0]
+    entry = repo._entry(blob0)
+    key = f"data/{entry.pack[:2]}/{entry.pack}"
+    body = bytearray(store.get(key))
+    body[entry.offset + 5] ^= 0xFF
+    store.put(key, bytes(body))
+    return entry.pack, key
+
+
+def test_read_repair_heals_corrupt_primary_from_mirror(tmp_path,
+                                                       monkeypatch):
+    """Corrupt primary + healthy mirror: the restore is byte-identical,
+    costs exactly ONE mirror re-fetch, and leaves the primary HEALED in
+    the store (verify-then-replace, the repo/scrub.py protocol)."""
+    import hashlib
+
+    monkeypatch.setenv("VOLSYNC_PACK_COPIES", "2")
+    src = _corpus(tmp_path)
+    mem = MemObjectStore()
+    _backup(mem, src)
+    assert list(mem.list("mirror/")), "copies=2 backup wrote no mirrors"
+    pack_id, key = _corrupt_first_file_blob(mem)
+
+    counted = _MirrorCountingStore(mem)
+    dst = tmp_path / "dst"
+    st = restore_snapshot(Repository.open(counted), dst)
+    assert st["files"] > 0
+    _assert_trees_identical(src, dst)
+    assert counted.mirror_gets == 1, \
+        "read-repair must fetch the mirror exactly once per corrupt pack"
+    # the primary was healed in place: whole-blob hash re-derives the id
+    assert hashlib.sha256(mem.get(key)).hexdigest() == pack_id
+
+
+def test_read_repair_both_copies_corrupt_raises_no_partial(tmp_path,
+                                                           monkeypatch):
+    """No healthy copy anywhere: the classic integrity contract holds —
+    IntegrityError before any byte of the batch lands, zero partial
+    files behind."""
+    monkeypatch.setenv("VOLSYNC_PACK_COPIES", "2")
+    rng = np.random.RandomState(13)
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "only.bin").write_bytes(rng.bytes(180_000))
+    mem = MemObjectStore()
+    _backup(mem, src)
+    pack_id, _ = _corrupt_first_file_blob(mem)
+    mbody = bytearray(mem.get(f"mirror/{pack_id}"))
+    mbody[0] ^= 0xFF  # mirror rot: sha no longer re-derives the id
+    mem.put(f"mirror/{pack_id}", bytes(mbody))
+
+    dst = tmp_path / "dst"
+    with pytest.raises(crypto.IntegrityError):
+        restore_snapshot(Repository.open(mem), dst)
+    assert [p for p in dst.rglob("*") if p.is_file()] == [], \
+        "failed restore left partial files behind"
+
+
+def test_read_repair_disabled_by_flag(tmp_path, monkeypatch):
+    """VOLSYNC_SCRUB_READ_REPAIR=0: a healthy mirror exists but the
+    restore must not touch it — corruption raises exactly as before the
+    feature existed."""
+    monkeypatch.setenv("VOLSYNC_PACK_COPIES", "2")
+    rng = np.random.RandomState(17)
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "only.bin").write_bytes(rng.bytes(150_000))
+    mem = MemObjectStore()
+    _backup(mem, src)
+    _corrupt_first_file_blob(mem)
+
+    monkeypatch.setenv("VOLSYNC_SCRUB_READ_REPAIR", "0")
+    counted = _MirrorCountingStore(mem)
+    dst = tmp_path / "dst"
+    with pytest.raises(crypto.IntegrityError):
+        restore_snapshot(Repository.open(counted), dst)
+    assert counted.mirror_gets == 0
+    assert [p for p in dst.rglob("*") if p.is_file()] == []

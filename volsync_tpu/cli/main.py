@@ -110,6 +110,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="repository recovery: orphaned packs, expired "
              "pending-deletes, dangling index entries "
              "(volsync_tpu.cli.repair)")
+    sub.add_parser(
+        "scrub", add_help=False,
+        help="integrity scrub: on-device pack verify, quarantine + "
+             "mirror heal of silent corruption (volsync_tpu.cli.scrub)")
 
     return parser
 
@@ -133,6 +137,10 @@ def run(argv, contexts: dict, out=print) -> int:
         from volsync_tpu.cli.repair import main as repair_main
 
         return repair_main(list(argv[1:]), out=out)
+    if argv and argv[0] == "scrub":
+        from volsync_tpu.cli.scrub import main as scrub_main
+
+        return scrub_main(list(argv[1:]), out=out)
     args = build_parser().parse_args(argv)
     config_dir = Path(args.config_dir)
     try:
@@ -179,14 +187,15 @@ def main(argv=None) -> int:
     """Demo-mode entry: boot a full in-process stack as the 'default'
     context (the operator's packaged entry point wires real state).
     ``volsync lint`` / ``volsync trace`` / ``volsync session`` /
-    ``volsync repair`` never need the runtime — dispatch them before
-    the boot so the linter runs in CI containers with no cluster state,
-    the flight recorder is readable from a half-broken process,
-    ``session status`` works on a host whose accelerator tunnel is
-    wedged, and repair can run against a store whose operator stack is
-    exactly what crashed."""
+    ``volsync repair`` / ``volsync scrub`` never need the runtime —
+    dispatch them before the boot so the linter runs in CI containers
+    with no cluster state, the flight recorder is readable from a
+    half-broken process, ``session status`` works on a host whose
+    accelerator tunnel is wedged, and repair/scrub can run against a
+    store whose operator stack is exactly what crashed."""
     argv = argv if argv is not None else sys.argv[1:]
-    if argv and argv[0] in ("lint", "trace", "session", "repair"):
+    if argv and argv[0] in ("lint", "trace", "session", "repair",
+                            "scrub"):
         return run(argv, {})
     from volsync_tpu.operator import OperatorRuntime
 

@@ -21,8 +21,16 @@ module mirrors that work for reads, in four stages:
    in ~64 MiB batches (engine/chunker.verify_blob_batch — the same
    page-grid kernel repository check uses) while later fetches are
    still in flight. A batch's bytes reach disk only after the batch
-   verifies; a mismatch raises before any byte of that batch is
-   written, and the failed restore leaves no partial file behind.
+   verifies. A mismatch first attempts READ-REPAIR
+   (``VOLSYNC_SCRUB_READ_REPAIR``, default on): one fetch of the
+   owning pack's mirror copy (``VOLSYNC_PACK_COPIES=2``), proven
+   byte-perfect against the content-addressed pack id, heals the
+   primary with one overwriting PUT (verify-then-replace — the
+   repo/scrub.py protocol) and the corrupt blobs re-decode from the
+   healthy body — so a restore storm survives bit-rot the scrubber
+   has not reached yet. Only when no healthy mirror exists does the
+   mismatch raise, before any byte of that batch is written, and the
+   failed restore leaves no partial file behind.
 4. **Write** (``restore.write``): verified blobs are written at their
    planned offsets with the serial path's sparse semantics (aligned
    all-zero pages become holes; chunk boundaries are page-aligned, so
@@ -45,6 +53,7 @@ serial path at runtime.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from collections import OrderedDict, deque
@@ -54,12 +63,21 @@ from typing import Optional
 
 from volsync_tpu import envflags
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
+from volsync_tpu.objstore.store import NoSuchKey
 from volsync_tpu.obs import current_context, record_trigger, span, use_context
 from volsync_tpu.repo import crypto
 from volsync_tpu.repo.packcache import PackCache
-from volsync_tpu.repo.repository import RepoError
+from volsync_tpu.repo.repository import (
+    RepoError,
+    mirror_key,
+    pack_key,
+)
 
 _M_RESTORE_BYTES = GLOBAL_METRICS.restore_bytes
+# read-repair shares the scrub's heal accounting (PR 6/8 cached-child
+# convention): a restore-side mirror heal is the same event as a
+# scrub-side one, just detected earlier
+_M_HEALED = GLOBAL_METRICS.scrub_packs.labels(outcome="healed")
 
 #: sentinel pack key for blobs still buffered in an active write
 #: pipeline (IndexEntry.pack == "") — read via the repository, no GET
@@ -149,6 +167,29 @@ def _plan(tr, jobs: list, stats: dict):
     return plans, placements, groups
 
 
+def _mirror_heal(repo, cache: PackCache, pack_id: str) -> Optional[bytes]:
+    """Read-repair heal: fetch the mirror copy, prove it byte-perfect
+    (the pack id is the SHA-256 of the whole sealed blob), heal the
+    primary with one overwriting PUT — verify-then-replace, never
+    delete-first — and evict the poisoned cache body so every later
+    fetch sees healthy bytes. Returns the healthy body, or None when no
+    byte-perfect mirror exists (single-copy repository, swept mirror,
+    or mirror rot)."""
+    try:
+        body = repo.store.get(mirror_key(pack_id))
+    except NoSuchKey:
+        return None
+    if hashlib.sha256(body).hexdigest() != pack_id:
+        return None
+    with span("scrub.heal"):
+        repo.store.put(pack_key(pack_id), body)
+    cache.invalidate(pack_id)
+    _M_HEALED.inc()
+    record_trigger("scrub_corruption", pack=pack_id,
+                   source="read_repair", healed=True)
+    return body
+
+
 def _execute(tr, repo, cache: PackCache, plans, placements,
              groups: "OrderedDict[str, list]", stats: dict) -> None:
     """Stages 2-4: bounded async pack fetch -> decode -> device-batched
@@ -166,6 +207,55 @@ def _execute(tr, repo, cache: PackCache, plans, placements,
     window = envflags.restore_fetch_window()
     batch: list[tuple[str, bytes]] = []
     batch_bytes = 0
+    # read-repair state: blob -> (pack, offset, length, raw_length)
+    # provenance for everything in ``batch``, and a per-pack memo of
+    # heal attempts (None = no healthy mirror) so a corrupt pack costs
+    # exactly ONE mirror re-fetch however many blobs/batches it spans
+    src: dict[str, tuple[str, int, int, int]] = {}
+    healed: dict[str, Optional[bytes]] = {}
+    repair_on = envflags.scrub_read_repair_enabled()
+
+    def healthy_body(pack_id: str) -> Optional[bytes]:
+        if not repair_on:
+            return None
+        if pack_id not in healed:
+            healed[pack_id] = _mirror_heal(repo, cache, pack_id)
+        return healed[pack_id]
+
+    def decode_member(body: bytes, blob_id: str, p_off: int, p_len: int,
+                      raw_len: int) -> bytes:
+        data = repo._decode_blob(body[p_off:p_off + p_len])
+        if len(data) != raw_len:
+            raise crypto.IntegrityError(
+                f"restore: blob {blob_id} length "
+                f"{len(data)} != indexed {raw_len}")
+        return data
+
+    def repair_batch(bad: list) -> None:
+        """Re-decode the corrupt entries of ``batch`` in place from
+        healed pack bodies and re-verify exactly those; raises
+        IntegrityError when any blob stays bad (no healthy mirror)."""
+        from volsync_tpu.engine.chunker import verify_blob_batch
+
+        bad_set = set(bad)
+        repaired: list[tuple[str, bytes]] = []
+        for i, (blob_id, _data) in enumerate(batch):
+            if blob_id not in bad_set:
+                continue
+            prov = src.get(blob_id)
+            body = healthy_body(prov[0]) if prov is not None else None
+            if body is None:
+                record_trigger("restore_verify_fail", blob=blob_id)
+                raise crypto.IntegrityError(
+                    f"restore: blob {blob_id} content hash mismatch")
+            batch[i] = (blob_id, decode_member(body, blob_id, *prov[1:]))
+            repaired.append(batch[i])
+        with span("restore.verify"):
+            still_bad = verify_blob_batch(repaired)
+        if still_bad:
+            record_trigger("restore_verify_fail", blob=still_bad[0])
+            raise crypto.IntegrityError(
+                f"restore: blob {still_bad[0]} content hash mismatch")
 
     def flush_batch():
         nonlocal batch, batch_bytes
@@ -176,9 +266,9 @@ def _execute(tr, repo, cache: PackCache, plans, placements,
         with span("restore.verify"):
             bad = verify_blob_batch(batch)
         if bad:
-            record_trigger("restore_verify_fail", blob=bad[0])
-            raise crypto.IntegrityError(
-                f"restore: blob {bad[0]} content hash mismatch")
+            # device verify caught wrong bytes: heal from the mirror
+            # before giving up (module docstring, stage 3)
+            repair_batch(bad)
         with span("restore.write"):
             for blob_id, data in batch:
                 for plan, offset in placements[blob_id]:
@@ -207,12 +297,25 @@ def _execute(tr, repo, cache: PackCache, plans, placements,
                         # buffered in an active write pipeline of this
                         # process — no pack object to fetch yet
                         data = repo.read_blob_raw(blob_id)
+                        if len(data) != raw_len:
+                            raise crypto.IntegrityError(
+                                f"restore: blob {blob_id} length "
+                                f"{len(data)} != indexed {raw_len}")
                     else:
-                        data = repo._decode_blob(body[p_off:p_off + p_len])
-                    if len(data) != raw_len:
-                        raise crypto.IntegrityError(
-                            f"restore: blob {blob_id} length "
-                            f"{len(data)} != indexed {raw_len}")
+                        src[blob_id] = (pack_id, p_off, p_len, raw_len)
+                        try:
+                            data = decode_member(body, blob_id, p_off,
+                                                 p_len, raw_len)
+                        except Exception:  # noqa: BLE001 — an
+                            # undecodable segment (torn seal, decompress
+                            # error, wrong length) is the same silent-
+                            # corruption class the verify stage catches;
+                            # try the mirror before dying
+                            mbody = healthy_body(pack_id)
+                            if mbody is None:
+                                raise
+                            data = decode_member(mbody, blob_id, p_off,
+                                                 p_len, raw_len)
                     batch.append((blob_id, data))
                     batch_bytes += len(data)
                     if batch_bytes >= tr._VERIFY_BATCH:

@@ -311,6 +311,52 @@ def gc_interval_seconds() -> float:
     return env_float("VOLSYNC_GC_INTERVAL_S", 60.0, minimum=0.1)
 
 
+# -- silent-corruption defense (repo/scrub.py, repo/repository.py) -------
+
+def pack_copies() -> int:
+    """VOLSYNC_PACK_COPIES: replicas written for every sealed pack.
+    1 (the default) keeps the classic single-copy layout; 2 additionally
+    writes each pack to ``mirror/<pack-id>`` through the same resilient
+    upload path, giving the scrub and restore read-repair a healthy body
+    to heal from. Values above 2 clamp to 2 (one mirror prefix)."""
+    return min(env_int("VOLSYNC_PACK_COPIES", 1, minimum=1), 2)
+
+
+def scrub_interval_seconds() -> float:
+    """VOLSYNC_SCRUB_INTERVAL_S: pause between continuous-scrub cycles
+    (repo/scrub.py). Each cycle verifies a bounded slice of packs
+    on-device, so the interval trades detection latency for read load
+    on the store."""
+    return env_float("VOLSYNC_SCRUB_INTERVAL_S", 60.0, minimum=0.1)
+
+
+def scrub_packs_per_cycle() -> int:
+    """VOLSYNC_SCRUB_PACKS: packs verified per scrub cycle, walked
+    round-robin so every pack is eventually visited. 0 (the default)
+    scrubs the whole repository each cycle — right for tests and the
+    one-shot ``volsync scrub`` verb; fleets set a budget."""
+    return env_int("VOLSYNC_SCRUB_PACKS", 0, minimum=0)
+
+
+def scrub_read_repair_enabled() -> bool:
+    """VOLSYNC_SCRUB_READ_REPAIR: when a pipelined restore's device
+    verify catches a corrupt blob, re-fetch the owning pack's mirror,
+    heal the primary (verify-then-replace) and keep restoring instead
+    of raising IntegrityError immediately. Default on; restores of
+    single-copy repositories are unaffected (no mirror -> classic
+    failure path)."""
+    return env_bool("VOLSYNC_SCRUB_READ_REPAIR", True)
+
+
+def device_verify_enabled() -> bool:
+    """VOLSYNC_DEVICE_VERIFY: check(read_data=True) verifies blob
+    payloads with the batched on-device hash path (packs cross the wire
+    once, ~64 MiB fused verify dispatches) instead of serial host-side
+    hashing. Default on since the scrub rides the same kernels; set 0
+    to force the pure-host reference path."""
+    return env_bool("VOLSYNC_DEVICE_VERIFY", True)
+
+
 # -- observability (obs/tracing.py) --------------------------------------
 
 def trace_dir() -> Optional[str]:

@@ -316,6 +316,24 @@ class Metrics:
             "Continuous-GC prune cycles, by outcome",
             ["outcome"], registry=self.registry,
         )
+        # Integrity scrub (repo/scrub.py) + restore read-repair: packs
+        # examined by outcome — "clean" (device verify passed), "healed"
+        # (quarantined, then mirror heal + re-verify succeeded; restore
+        # read-repair heals count here too), "quarantined" (corruption
+        # detected, quarantine manifest written — every healed/unhealable
+        # pack passes through this), "unhealable" (no healthy mirror;
+        # the quarantine manifest stays and record_trigger escalates).
+        self.scrub_packs = Counter(
+            "volsync_scrub_packs_total",
+            "Packs examined by the integrity scrub, by outcome",
+            ["outcome"], registry=self.registry,
+        )
+        self.scrub_bytes = Counter(
+            "volsync_scrub_bytes_total",
+            "Pack bytes fetched and device-verified by the integrity "
+            "scrub",
+            registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":

@@ -34,6 +34,15 @@ ObjectStore and injects faults according to a seeded ``FaultSchedule``:
                     fleet drill's mid-outage failover rides this.
                     While partitioned, other specs' counters do not
                     advance (those ops never arrived at the store).
+- ``bitflip``     — SILENT corruption: a ``get``/``get_range`` payload
+                    comes back with ``nbytes=`` byte positions XORed
+                    (default 1) and NO exception raised — the bit-rot /
+                    wrong-bytes fault class every loud kind above
+                    misses. Corrupted positions and masks are a pure
+                    hash of ``(seed, key, nth-occurrence)`` so the same
+                    seed rots the same bytes on every run. Only read
+                    ops match (the spec's ``at=N`` counter counts reads
+                    only); the stored object itself is untouched.
 
 Determinism: probability rolls are a pure hash of
 ``(seed, spec, op, key, nth-occurrence-of(op,key))`` — independent of
@@ -103,9 +112,11 @@ _HANG_DEFAULT_S = 60.0
 _PARTITION_DEFAULT_S = 5.0
 
 _KINDS = ("transient", "throttle", "latency", "partial_put",
-          "truncated_read", "crash", "hang", "partition")
+          "truncated_read", "crash", "hang", "partition", "bitflip")
 #: ops that mutate the store — the ones ``landed`` applies to
 _WRITE_OPS = ("put", "put_if_absent", "delete")
+#: ops returning a payload — the only ones ``bitflip`` can corrupt
+_PAYLOAD_OPS = ("get", "get_range")
 
 
 @dataclass(frozen=True)
@@ -119,12 +130,18 @@ class FaultSpec:
     key_prefix: str = ""       # key startswith filter
     landed: bool = False       # write ops: inner op completes first
     latency: float = 0.0       # seconds, for kind="latency"
+    nbytes: int = 1            # byte positions flipped, for kind="bitflip"
 
     def matches(self, op: str, key: str) -> bool:
         # ``op`` accepts a pipe-separated list ("put|delete") so one
         # crash counter can span every write stage of a multi-op
         # protocol (the two-phase prune's chaos schedules need
         # crash-at-op-N across its put AND delete boundaries).
+        if self.kind == "bitflip" and op not in _PAYLOAD_OPS:
+            # silent corruption only exists on payload-returning ops;
+            # keeping non-reads out of ``matches`` keeps the spec's
+            # at=N counter a pure read counter
+            return False
         if self.op != "*" and op not in self.op.split("|"):
             return False
         return key.startswith(self.key_prefix)
@@ -159,6 +176,8 @@ def parse_spec(text: str) -> list[FaultSpec]:
                 kwargs["landed"] = v not in ("", "0", "false", "no")
             elif k == "ms":
                 kwargs["latency"] = float(v) / 1000.0
+            elif k == "nbytes":
+                kwargs["nbytes"] = int(v)
             else:
                 raise ValueError(f"unknown fault spec field {k!r}")
         specs.append(FaultSpec(kind=kind, **kwargs))
@@ -220,9 +239,13 @@ class FaultStore:
 
     # -- decision core ----------------------------------------------------
 
-    def _decide(self, op: str, key: str) -> list[FaultSpec]:
-        """All specs firing on this arrival, recorded. Raises
-        InjectedCrash immediately when the store is already dead."""
+    def _decide(self, op: str, key: str) -> tuple[list[FaultSpec], int, int]:
+        """All specs firing on this arrival (with the arrival's op index
+        and per-(op,key) occurrence number), recorded — except
+        ``bitflip``, which is recorded by ``_apply`` only when a
+        corrupted payload actually reached the caller (a louder spec on
+        the same arrival masks it). Raises InjectedCrash immediately
+        when the store is already dead."""
         with self._lock:
             if self.crashed:
                 raise InjectedCrash(
@@ -247,17 +270,35 @@ class FaultStore:
                        else self.schedule.roll(i, op, key, n) < spec.p)
                 if hit:
                     fired.append(spec)
-                    self.injected.append((opix, op, key, spec.kind))
+                    if spec.kind != "bitflip":
+                        self.injected.append((opix, op, key, spec.kind))
             if any(s.kind == "crash" for s in fired):
                 self.crashed = True
-        return fired
+        return fired, opix, n
+
+    def _corrupt(self, data: bytes, key: str, n: int,
+                 nbytes: int) -> bytes:
+        """Deterministically XOR ``nbytes`` byte positions of ``data``.
+        Positions and masks are a pure hash of (seed, key, nth) — the
+        same seed rots the same bytes on every run — and every mask has
+        its low bit set so a flipped byte always differs."""
+        if not data:
+            return data
+        out = bytearray(data)
+        for i in range(max(1, nbytes)):
+            h = hashlib.blake2b(
+                f"{self.schedule.seed}:bitflip:{key}:{n}:{i}".encode(),
+                digest_size=8).digest()
+            pos = int.from_bytes(h[:6], "big") % len(out)
+            out[pos] ^= h[6] | 0x01
+        return bytes(out)
 
     def _apply(self, op: str, key: str, execute, *,
                torn_execute=None):
         """Run one op under the schedule. ``execute()`` performs the
         real operation; ``torn_execute()`` (writes only) performs the
         truncated form for partial_put."""
-        fired = self._decide(op, key)
+        fired, opix, n = self._decide(op, key)
         if fired:
             # flight-recorder annotation, outside self._lock (_decide
             # released it) so the dump can never nest under it
@@ -285,7 +326,17 @@ class FaultStore:
                 execute()
             raise InjectedCrash(f"injected crash at {op} {key!r}")
         if err is None:
-            return execute()
+            result = execute()
+            flips = [s for s in fired if s.kind == "bitflip"]
+            if flips:
+                # silent wrong-bytes: the op SUCCEEDS and the caller
+                # receives a corrupted payload — one corruption per
+                # arrival (widest nbytes wins when several specs fire),
+                # recorded only now that it actually reached a caller
+                result = self._corrupt(result, key, n,
+                                       max(s.nbytes for s in flips))
+                self.injected.append((opix, op, key, "bitflip"))
+            return result
         if err.kind == "hang":
             # Block past the caller's deadline, then surface as a drop
             # (the op never reached the store — nothing lands).

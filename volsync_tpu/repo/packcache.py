@@ -152,6 +152,24 @@ class PackCache:
         flight.done.set()
         return body
 
+    def invalidate(self, pack_id: str) -> bool:
+        """Drop one cached body — the ONLY mutation of an entry.
+
+        Pack bodies are immutable in the store, but the cache can have
+        memorized a payload that arrived CORRUPTED (bit-rot, a wire
+        flip): after a heal rewrites the primary, the healer must evict
+        the poisoned body so the next get_pack re-fetches healthy
+        bytes. The Bloom prefilter's bit stays set (bits only turn on);
+        the re-fetch just pays one LRU probe. Returns True if a body
+        was dropped. An in-flight fetch is untouched — its waiters get
+        whatever the store returned, and THEIR verify decides."""
+        with self._lock:
+            body = self._lru.pop(pack_id, None)
+            if body is None:
+                return False
+            self._bytes -= len(body)
+            return True
+
     def get_ranges(self, pack_id: str,
                    spans: list[tuple[int, int]]) -> list[bytes]:
         """Coalesced ranged read: ONE pack fetch serves every

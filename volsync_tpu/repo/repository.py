@@ -19,6 +19,10 @@ Layout in the object store:
     takeover/<lock-id>          atomic claim to remove one stale lock
     fenced/<writer-id>          fence marker: that writer's publishes refuse
     pending-delete/<id>         two-phase prune manifests (marked packs)
+    mirror/<pack-id>            second pack copy (VOLSYNC_PACK_COPIES=2):
+                                the heal source for scrub + read-repair
+    quarantine/<pack-id>        scrub corruption manifest; removed after a
+                                successful mirror heal + re-verify
 
 Multi-writer protocol (docs/robustness.md): N concurrent backup writers
 plus one prune-mode pruner share a repository; generation fencing
@@ -53,6 +57,24 @@ from volsync_tpu.resilience import ResilientStore, RetryPolicy
 
 BLOB_DATA = "data"
 BLOB_TREE = "tree"
+
+
+def pack_key(pack_id: str) -> str:
+    """Primary store key of a sealed pack."""
+    return f"data/{pack_id[:2]}/{pack_id}"
+
+
+def mirror_key(pack_id: str) -> str:
+    """Second-copy key (VOLSYNC_PACK_COPIES=2) — the heal source the
+    scrub and restore read-repair fetch when the primary rots."""
+    return f"mirror/{pack_id}"
+
+
+def quarantine_key(pack_id: str) -> str:
+    """Scrub corruption manifest for one pack (plaintext JSON; see
+    repo/scrub.py). Present = that pack failed device verify and has
+    not yet been healed + re-verified."""
+    return f"quarantine/{pack_id}"
 
 _VERIFIER_PLAINTEXT = b"volsync-tpu repository key verifier v1"
 _COMPRESS_MIN_GAIN = 0.9  # keep compressed form only if <= 90% of raw
@@ -281,6 +303,10 @@ class Repository:
         self._published_deltas: list[str] = []
         #: store keys of lock objects this instance currently holds
         self._held_locks: set[str] = set()
+        #: VOLSYNC_PACK_COPIES — 2 mirrors every sealed pack to
+        #: mirror/<pack-id> (the scrub/read-repair heal source); each
+        #: copy rides the same resilient upload path as the primary.
+        self.pack_copies = envflags.pack_copies()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1034,15 +1060,23 @@ class Repository:
                 self._zc.compress(json.dumps(entries).encode()))
             blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
             pack_id = hashlib.sha256(blob).hexdigest()
-            key = f"data/{pack_id[:2]}/{pack_id}"
             with span("repo.pack_upload"):
-                if self._store_retries:
-                    self.store.put(key, blob)
-                else:
-                    self._upload_policy.call(self.store.put, key, blob)
+                self._put_pack_blob(pack_key(pack_id), blob)
+                if self.pack_copies >= 2:
+                    self._put_pack_blob(mirror_key(pack_id), blob)
             return pack_id
         finally:
             self._pl_upload_slots.release()
+
+    def _put_pack_blob(self, key: str, blob: bytes) -> None:
+        """One pack-copy PUT under exactly one retry layer: the store's
+        own (ResilientStore) when it carries one, _upload_policy
+        otherwise — the no-stacking rule from the constructor. The
+        mirror copy rides the identical path as the primary."""
+        if self._store_retries:
+            self.store.put(key, blob)
+        else:
+            self._upload_policy.call(self.store.put, key, blob)
 
     def _pl_reap(self, block: bool):
         """Register completed uploads in FIFO (pack creation) order:
@@ -1112,7 +1146,9 @@ class Repository:
         blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
         pack_id = hashlib.sha256(blob).hexdigest()
         with span("repo.pack_upload"):
-            self.store.put(f"data/{pack_id[:2]}/{pack_id}", blob)
+            self.store.put(pack_key(pack_id), blob)
+            if self.pack_copies >= 2:
+                self.store.put(mirror_key(pack_id), blob)
         for e in self._cur_entries:
             cur = self._index.lookup(e["id"])
             if (cur is None or cur[0] == ""
@@ -1707,9 +1743,14 @@ class Repository:
                       | set(self._published_deltas[own_mark:])) - new_keys
         for key in superseded:
             self.store.delete(key)
-        # Step 5: sweep expired packs, then their manifests.
+        # Step 5: sweep expired packs — primary, mirror copy, and any
+        # stale quarantine manifest ride one sweep (deletes are
+        # idempotent, so a crash between them re-runs safely) — then
+        # their pending-delete manifests.
         for pack in sorted(sweep_packs):
-            self.store.delete(f"data/{pack[:2]}/{pack}")
+            self.store.delete(pack_key(pack))
+            self.store.delete(mirror_key(pack))
+            self.store.delete(quarantine_key(pack))
         for key in sweep_keys:
             self.store.delete(key)
         self._pending_index = {}
@@ -1806,6 +1847,15 @@ class Repository:
                         continue
                     if now >= deadline:
                         expired.append(key)
+                # Mirror debris (VOLSYNC_PACK_COPIES=2): a mirror whose
+                # primary is gone — a crash between the sweep's primary
+                # and mirror deletes — is unreferenced by construction
+                # (every reader resolves the primary key first) and safe
+                # to drop. Missing mirrors are NOT re-created here; the
+                # scrub heals those from the verified primary.
+                stray_mirrors = sorted(
+                    key for key in self.store.list("mirror/")
+                    if key.rsplit("/", 1)[1] not in store_packs)
                 stale_markers = []
                 # fleet/ heartbeat stamps (service/fleet.py) join the
                 # marker scan: a stamp a replica never retired outlives
@@ -1857,6 +1907,8 @@ class Repository:
                         self._published_deltas = list(new_keys)
                     for key in stale_markers:
                         self.store.delete(key)
+                    for key in stray_mirrors:
+                        self.store.delete(key)
                     for key in old_gens:
                         self.store.delete(key)
                     if not broken_trees and not unrecoverable:
@@ -1872,6 +1924,7 @@ class Repository:
                 "pending_manifests": len(manifests),
                 "expired_manifests": len(expired),
                 "stale_markers": sorted(stale_markers),
+                "stray_mirrors": stray_mirrors,
                 "gc": gc,
             }
 
@@ -1931,11 +1984,13 @@ class Repository:
         blobs verified concurrently — store IO + decrypt overlap;
         read_blob and the zstd path are thread-safe).
 
-        ``device_verify`` (default: env VOLSYNC_DEVICE_VERIFY) re-hashes
-        the read blobs in DEVICE batches instead of per-blob host SHA —
-        decrypt/decompress stay on host, but the per-byte hashing rides
-        the page-grid kernel (engine/chunker.hash_spans), so a full
-        1 TiB verify is bounded by store IO + decompress, not hashlib."""
+        ``device_verify`` (default: env VOLSYNC_DEVICE_VERIFY, ON unless
+        explicitly disabled) re-hashes the read blobs in ~64 MiB DEVICE
+        batches instead of per-blob host SHA — decrypt/decompress stay
+        on host, but the per-byte hashing rides the page-grid kernel
+        (engine/chunker.hash_spans), so a full 1 TiB verify is bounded
+        by store IO + decompress, not hashlib. Both paths flag the same
+        blob set (the serial path is kept as the golden reference)."""
         problems = []
         with self._lock:
             entries = self._index.copy()  # three array copies, no objects
@@ -1955,9 +2010,7 @@ class Repository:
             if read_data:
                 to_read.append(blob_id)
         if device_verify is None:
-            from volsync_tpu.envflags import env_bool
-
-            device_verify = env_bool("VOLSYNC_DEVICE_VERIFY")
+            device_verify = envflags.device_verify_enabled()
         if to_read and device_verify:
             problems.extend(self._verify_blobs_device(to_read, workers))
         elif to_read:
