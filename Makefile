@@ -5,10 +5,11 @@
 .PHONY: lint test chaos chaos-concurrent chaos-fleet chaos-restore \
 	chaos-scrub scrub-smoke static-check bench-index-smoke \
 	service-bench-smoke fleet-bench-smoke restore-bench-smoke \
-	syncplan-bench-smoke trace-smoke session-smoke clean-lint
+	copies-smoke syncplan-bench-smoke trace-smoke session-smoke \
+	clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
-# all rule families, VL001-VL005 + VL105 + VL301 per-file + VL101-VL104
+# all rule families, VL001-VL005 + VL105/VL106 + VL301 per-file + VL101-VL104
 # interprocedural + VL201-VL205 shape/dtype abstract interpretation, no
 # baseline. Warm runs re-analyze zero files; see docs/development.md.
 lint:
@@ -114,6 +115,13 @@ fleet-bench-smoke:
 # Scale-accurate numbers need the full run: `python bench.py restore`.
 restore-bench-smoke:
 	python bench.py restore --smoke
+
+# Zero-copy contract gate (docs/performance.md, "Zero-copy data
+# movement"): backup + restore data planes at smoke scale; fails on a
+# ledgered copy site outside obs.SANCTIONED_SITES or a copy_ratio over
+# the committed COPY_RATIO_MAX threshold stamped in the artifact.
+copies-smoke:
+	python bench.py copies-smoke
 
 # Protocol-planner replay at smoke scale (docs/performance.md,
 # "Protocol planner"): three canned workloads (cold full, 1%-churn,

@@ -115,6 +115,42 @@ def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
+# Copy-ratio regression thresholds (``bench.py copies-smoke``).
+# copy_ratio = ledgered host copy bytes / payload bytes moved through
+# the timed pipelined run; the smoke FAILS when a measured ratio
+# exceeds its committed maximum, so a new unledgered copy path can't
+# land silently. Raising a threshold is a reviewed change, like adding
+# a record_copy site. Values carry ~20% headroom over the measured
+# smoke-scale ratios — pipeline 2.0 (chunker.ingest for the read()-only
+# bench reader + objstore.assemble for the contiguous Mem transport),
+# restore 1.0 (verify.stage) — see docs/performance.md, "Zero-copy
+# data movement" for what each remaining site pays.
+COPY_RATIO_MAX = {"pipeline": 2.4, "restore": 1.2}
+
+
+def _copy_report(total_bytes: int, kind: str, legacy_passes: float) -> dict:
+    """Ledger snapshot for the timed window -> artifact block.
+
+    ``copy_ratio`` is ledgered host copy bytes per payload byte;
+    ``copy_ratio_pre`` is an ANALYTIC estimate (ratio + the full
+    payload passes the legacy sites paid: monolithic pack-body
+    assembly on backup, slice-of-pack-body segment extraction on
+    restore) — documented in the artifact so the drop is visible
+    without resurrecting the old code path."""
+    from volsync_tpu.obs import copies_by_site
+
+    sites = {k: int(v) for k, v in sorted(copies_by_site().items())}
+    copied = sum(sites.values())
+    ratio = round(copied / max(1, total_bytes), 3)
+    return {
+        "copy_bytes_by_site": sites,
+        "copy_bytes_total": copied,
+        "copy_ratio": ratio,
+        "copy_ratio_pre_estimate": round(ratio + legacy_passes, 3),
+        "copy_ratio_max": COPY_RATIO_MAX[kind],
+    }
+
+
 def bench_provenance(extra: Optional[dict] = None) -> dict:
     """Provenance block stamped into every bench JSON result: platform,
     git rev, the VOLSYNC_*/JAX_PLATFORMS knobs in effect, and — only
@@ -969,6 +1005,7 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
     from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
     from volsync_tpu.obs import (
         dump_trace,
+        reset_copies,
         reset_spans,
         reset_trace,
         span_totals,
@@ -1019,6 +1056,7 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
 
         reset_spans()
         reset_trace()
+        reset_copies()
         ids: list = []
         t0 = time.perf_counter()
         with trace_context(tenant="bench"):
@@ -1047,6 +1085,10 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
         serial_s, serial_spans, _, _, _, _ = run(False)
         (pipe_s, pipe_spans, pipe_store, pipe_injected, pipe_repo,
          pipe_ids) = run(True)
+        # snapshot the ledger before dedup_compare touches the repo —
+        # legacy removed one full payload pass (monolithic pack-body
+        # assembly), hence legacy_passes=1.0
+        copies = _copy_report(total, "pipeline", legacy_passes=1.0)
     finally:
         sys.setswitchinterval(prev_switch)
 
@@ -1100,12 +1142,14 @@ def pipeline_bench(total_mib: int = 24, put_latency_s: float = 0.04,
         "put_latency_ms": round(put_latency_s * 1000, 1),
         "stages": stages(pipe_spans),
         "stages_serial": stages(serial_spans),
+        "copy_ratio": copies["copy_ratio"],
+        "copies": copies,
         "dedup_compare": dedup_compare(pipe_repo, pipe_ids),
         # ROADMAP item 1 follow-on: every bench JSON self-describes
         # where its time went. The flight recorder still holds the
         # pipelined (last) run; trace_file is null unless
         # VOLSYNC_TRACE_DUMP names a directory to export into.
-        "provenance": bench_provenance(extra={"trace": {
+        "provenance": bench_provenance(extra={"copies": copies, "trace": {
             "spans": {name: {"count": c, "seconds": round(s, 4)}
                       for name, (c, s) in sorted(pipe_spans.items())},
             "trace_file": dump_trace(trigger="bench_pipeline"),
@@ -1144,7 +1188,7 @@ def restore_bench(total_mib: int = 24, get_latency_s: float = 0.04,
 
     from volsync_tpu.engine import RestoreGroup, TreeBackup, TreeRestore
     from volsync_tpu.objstore.store import LatencyStore, MemObjectStore
-    from volsync_tpu.obs import reset_spans, span_totals
+    from volsync_tpu.obs import reset_copies, reset_spans, span_totals
     from volsync_tpu.repo.repository import Repository
 
     total = total_mib << 20
@@ -1180,6 +1224,7 @@ def restore_bench(total_mib: int = 24, get_latency_s: float = 0.04,
             lat = LatencyStore(mem, get_latency=latency)
             r = Repository.open(lat)
             reset_spans()
+            reset_copies()
             t0 = time.perf_counter()
             with r.lock(exclusive=False):
                 r.load_index()
@@ -1213,6 +1258,10 @@ def restore_bench(total_mib: int = 24, get_latency_s: float = 0.04,
                                       workdir / "serial-conc")
             pipe_s, pipe_spans, pipe_lat = run(True, get_latency_s,
                                                workdir / "pipe")
+            # ledger snapshot before the storm muddies attribution —
+            # legacy sliced every segment out of a bytes pack body
+            # (one full payload pass), hence legacy_passes=1.0
+            copies = _copy_report(total, "restore", legacy_passes=1.0)
             storm_s, cache_stats, storm_lat = run_storm(get_latency_s)
         finally:
             sys.setswitchinterval(prev_switch)
@@ -1252,12 +1301,72 @@ def restore_bench(total_mib: int = 24, get_latency_s: float = 0.04,
             },
             "stages": stages(pipe_spans),
             "stages_serial": stages(serial_spans),
+            "copy_ratio": copies["copy_ratio"],
+            "copies": copies,
             "smoke": smoke,
             "provenance": bench_provenance(extra={
+                "copies": copies,
                 "restore": {"total_mib": total_mib, "files": nfiles}}),
         }
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
+
+
+def copies_smoke() -> dict:
+    """Copy-ledger contract gate (``bench.py copies-smoke``, wired into
+    scripts/static_check.sh via ``make copies-smoke``).
+
+    Runs the backup and restore data planes at smoke scale and asserts
+    the zero-copy contract on both artifacts:
+
+    - every ledgered site is in ``obs.SANCTIONED_SITES`` — a new
+      ``record_copy`` call must also amend the canonical set;
+    - the measured ``copy_ratio`` stays at or under the committed
+      ``COPY_RATIO_MAX`` threshold stamped into the artifact — a new
+      unledgered full-payload copy shows up here as a ratio jump;
+    - the artifact carries the copies block (``copy_bytes_by_site``,
+      ``copy_ratio``, the threshold) in both the result and its
+      provenance, so the contract is self-describing.
+
+    Exits nonzero on any violation."""
+    from volsync_tpu.obs import SANCTIONED_SITES
+
+    pipe = pipeline_bench(total_mib=8, put_latency_s=0.005)
+    rest = restore_bench(total_mib=6, get_latency_s=0.005, storm=2,
+                         smoke=True)
+    failures: list = []
+    for kind, res in (("pipeline", pipe), ("restore", rest)):
+        block = res.get("copies") or {}
+        if not block or "copy_bytes_by_site" not in block:
+            failures.append(f"{kind}: artifact missing copies block")
+            continue
+        if res.get("copy_ratio") != block["copy_ratio"]:
+            failures.append(f"{kind}: top-level copy_ratio missing or "
+                            f"inconsistent with copies block")
+        if block != (res.get("provenance", {}).get("copies")):
+            failures.append(f"{kind}: provenance missing copies block")
+        unknown = sorted(set(block["copy_bytes_by_site"])
+                         - SANCTIONED_SITES)
+        if unknown:
+            failures.append(f"{kind}: unsanctioned copy sites {unknown}")
+        if block["copy_ratio"] > block["copy_ratio_max"]:
+            failures.append(
+                f"{kind}: copy_ratio {block['copy_ratio']} exceeds the "
+                f"committed max {block['copy_ratio_max']}")
+    return {
+        "metric": "copy_ledger_smoke",
+        "value": len(failures),
+        "unit": "violations",
+        "ok": not failures,
+        "failures": failures,
+        "pipeline": {"copy_ratio": pipe.get("copy_ratio"),
+                     "copies": pipe.get("copies"),
+                     "throughput_mib_s": pipe.get("throughput_mib_s")},
+        "restore": {"copy_ratio": rest.get("copy_ratio"),
+                    "copies": rest.get("copies"),
+                    "throughput_mib_s": rest.get("throughput_mib_s")},
+        "provenance": bench_provenance(),
+    }
 
 
 def syncplan_bench(smoke: bool = True) -> dict:
@@ -1565,6 +1674,13 @@ def main():
         _emit(restore_bench(total_mib=6 if smoke else 24,
                             storm=storm, smoke=smoke))
         return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "copies-smoke":
+        # Zero-copy contract gate: both data planes at smoke scale,
+        # site sanction + copy_ratio threshold asserted; host-side.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        res = copies_smoke()
+        _emit(res)
+        return 0 if res["ok"] else 1
     if len(sys.argv) > 1 and sys.argv[1] == "syncplan":
         # Protocol-planner replay: host + CPU device kernels only.
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -4,8 +4,9 @@
 #   1. `volsync lint` over the whole tree — package, scripts/ and
 #      bench.py — must be clean with no baseline, with every rule
 #      family enabled: the per-file VL001-VL005 checks plus VL105
-#      (ad-hoc retry sleeps outside resilience.py) and VL301 (span
-#      names must be literal dotted lowercase), the interprocedural
+#      (ad-hoc retry sleeps outside resilience.py), VL106 (hot-path
+#      byte copies outside the sanctioned copy-ledger sites) and VL301
+#      (span names must be literal dotted lowercase), the interprocedural
 #      VL101-VL104 family, and the VL201-VL205
 #      shape/dtype abstract interpreter
 #      (tests/test_analysis.py enforces the same in tier-1). Emits a
@@ -53,6 +54,12 @@
 #      serial vs pipelined vs storm over the 40 ms fake store; keeps
 #      the restore data plane's JSON contract runnable
 #      (docs/performance.md, "Restore data plane").
+#  11b. The zero-copy contract gate (`make copies-smoke`): backup +
+#      restore data planes at smoke scale; every ledgered copy site
+#      must be in obs.SANCTIONED_SITES and the measured copy_ratio
+#      must stay under the committed COPY_RATIO_MAX threshold stamped
+#      into the artifact (docs/performance.md, "Zero-copy data
+#      movement").
 #  12. The protocol-planner replay at smoke scale
 #      (`make syncplan-bench-smoke`): three canned workloads measured
 #      with the real engines and scored against the oracle — the
@@ -108,6 +115,9 @@ make --no-print-directory chaos-restore
 
 echo "== restore-bench-smoke =="
 make --no-print-directory restore-bench-smoke > /dev/null
+
+echo "== copies-smoke =="
+make --no-print-directory copies-smoke > /dev/null
 
 echo "== syncplan-bench-smoke =="
 make --no-print-directory syncplan-bench-smoke > /dev/null

@@ -1,5 +1,5 @@
 """The analyzer analyzed: seeded-violation fixtures per rule (per-file
-VL001-VL005/VL105/VL301 and interprocedural VL101-VL104), call-graph
+VL001-VL005/VL105/VL106/VL301 and interprocedural VL101-VL104), call-graph
 resolution
 over the committed mini-package in ``analysis_fixtures/``, baseline
 add/expire, suppression comments, SARIF emission, the incremental
@@ -199,6 +199,34 @@ def test_vl105_suppression(tmp_path):
            "        pass\n"
            "    time.sleep(1)  # lint: ignore[VL105] — paced poll\n")
     assert _lint_file(tmp_path, src) == []
+
+
+def test_vl106_hot_path_copies(tmp_path):
+    src = (
+        "def seal(view, parts, n):\n"
+        "    a = view.tobytes()\n"                  # VL106: materializes
+        "    b = bytes(view)\n"                     # VL106: buffer copy
+        "    c = b''.join(parts)\n"                 # VL106: contiguous join
+        "    ok1 = bytes(16)\n"                     # allocation, not a copy
+        "    ok2 = bytes()\n"                       # empty, no argument
+        "    ok3 = ','.join(str(p) for p in parts)\n"  # str join
+        "    ok4 = n.to_bytes(8, 'big')\n"          # int serialization
+        "    return a, b, c, ok1, ok2, ok3, ok4\n"
+    )
+    findings = _lint_file(tmp_path, src, subdir="repo")
+    assert _codes(findings) == ["VL106"] * 3
+    assert {f.line for f in findings} == {2, 3, 4}
+    # engine/ and ops/ are data-plane scope too; the service plane and
+    # cluster control plane are not
+    assert _codes(_lint_file(tmp_path, src, subdir="engine")) == ["VL106"] * 3
+    assert _lint_file(tmp_path, src, subdir="service") == []
+    assert _lint_file(tmp_path, src, subdir="cluster") == []
+
+
+def test_vl106_suppression(tmp_path):
+    src = ("def download(digests):\n"
+           "    return digests.tobytes()  # lint: ignore[VL106] 32 B digests\n")
+    assert _lint_file(tmp_path, src, subdir="ops") == []
 
 
 def test_vl301_dynamic_span_names_flagged(tmp_path):

@@ -280,6 +280,49 @@ def test_swift_unsupported_credential_families():
             "OS_PASSWORD": "pw", "OS_PROJECT_NAME": "proj"})
 
 
+def _backend_factory(backend, tmp_path, stack):
+    """-> ``mk(prefix)`` over one of the real backends, the in-process
+    fake server entered on ``stack`` — shared plumbing for the
+    cross-backend contract tests."""
+    if backend == "s3":
+        from volsync_tpu.objstore.fakes3 import FakeS3Server
+        from volsync_tpu.objstore.s3 import S3ObjectStore
+
+        srv = stack.enter_context(FakeS3Server())
+
+        def mk(p):
+            return S3ObjectStore(srv.endpoint, "bucket", p,
+                                 access_key=srv.access_key,
+                                 secret_key=srv.secret_key)
+    elif backend == "azure":
+        srv = stack.enter_context(FakeAzureServer())
+
+        def mk(p):
+            return AzureBlobStore(srv.endpoint, srv.account,
+                                  srv.key_b64, "backups", p)
+    elif backend == "swift":
+        from volsync_tpu.objstore.fakeswift import FakeSwiftServer
+
+        srv = stack.enter_context(FakeSwiftServer())
+        env = {
+            "OS_AUTH_URL": srv.endpoint + "/v3",
+            "OS_USERNAME": srv.username,
+            "OS_PASSWORD": srv.password,
+            "OS_PROJECT_NAME": srv.project,
+            "OS_REGION_NAME": srv.region,
+        }
+
+        def mk(p):
+            return open_store(f"swift:backups:/{p}", env=env)
+    else:
+        from volsync_tpu.objstore.store import FsObjectStore
+
+        def mk(p):
+            return FsObjectStore(tmp_path / p)
+
+    return mk
+
+
 @pytest.mark.parametrize("faults", [False, True],
                          ids=["plain", "faultstore"])
 @pytest.mark.parametrize("backend", ["s3", "azure", "swift", "fs"])
@@ -296,42 +339,7 @@ def test_list_empty_prefix_contract(backend, faults, tmp_path):
     from contextlib import ExitStack
 
     with ExitStack() as stack:
-        if backend == "s3":
-            from volsync_tpu.objstore.fakes3 import FakeS3Server
-            from volsync_tpu.objstore.s3 import S3ObjectStore
-
-            srv = stack.enter_context(FakeS3Server())
-
-            def mk(p):
-                return S3ObjectStore(srv.endpoint, "bucket", p,
-                                     access_key=srv.access_key,
-                                     secret_key=srv.secret_key)
-        elif backend == "azure":
-            srv = stack.enter_context(FakeAzureServer())
-
-            def mk(p):
-                return AzureBlobStore(srv.endpoint, srv.account,
-                                      srv.key_b64, "backups", p)
-        elif backend == "swift":
-            from volsync_tpu.objstore.fakeswift import FakeSwiftServer
-
-            srv = stack.enter_context(FakeSwiftServer())
-            env = {
-                "OS_AUTH_URL": srv.endpoint + "/v3",
-                "OS_USERNAME": srv.username,
-                "OS_PASSWORD": srv.password,
-                "OS_PROJECT_NAME": srv.project,
-                "OS_REGION_NAME": srv.region,
-            }
-
-            def mk(p):
-                return open_store(f"swift:backups:/{p}", env=env)
-        else:
-            from volsync_tpu.objstore.store import FsObjectStore
-
-            def mk(p):
-                return FsObjectStore(tmp_path / p)
-
+        mk = _backend_factory(backend, tmp_path, stack)
         base_mk = mk
         if faults:
             # zero faults scheduled: every op must behave exactly as on
@@ -360,6 +368,42 @@ def test_list_empty_prefix_contract(backend, faults, tmp_path):
             a.delete("fresh")
             assert not a.exists("fresh")
             assert a.injected == [] and b.injected == []
+
+
+@pytest.mark.parametrize("backend", ["s3", "azure", "swift", "fs", "mem"])
+def test_put_iovec_contract(backend, tmp_path):
+    """Cross-backend PutBody contract (objstore/store.py): ``put`` and
+    ``put_if_absent`` accept bytes, bytearray, memoryview AND a
+    list/tuple of those — the vectored pack seal's iovec — and the
+    stored object equals the joined bytes on every backend, whether it
+    scatter-writes the parts (fs ``writelines``) or materializes one
+    contiguous body for its transport (HTTP backends, mem)."""
+    from contextlib import ExitStack
+
+    from volsync_tpu.objstore.store import MemObjectStore
+
+    payload = b"\x00\x01volsync" * 700 + b"tail"
+    parts = [payload[:128], bytearray(payload[128:3000]),
+             memoryview(payload)[3000:]]
+    with ExitStack() as stack:
+        if backend == "mem":
+            store = MemObjectStore()
+        else:
+            store = _backend_factory(backend, tmp_path, stack)("ns/repo")
+        store.put("iovec", parts)
+        assert store.get("iovec") == payload
+        assert store.size("iovec") == len(payload)
+        store.put("view", memoryview(payload))
+        assert store.get("view") == payload
+        store.put("ba", bytearray(payload))
+        assert store.get("ba") == payload
+        store.put("tuple", (b"he", memoryview(b"llo"), bytearray(b"!")))
+        assert store.get("tuple") == b"hello!"
+        assert store.put_if_absent("iovec", [b"z"]) is False
+        assert store.get("iovec") == payload
+        assert store.put_if_absent("fresh", [memoryview(b"ab"),
+                                             bytearray(b"c")]) is True
+        assert store.get("fresh") == b"abc"
 
 
 def test_swift_temp_url_routes_same_client(swift):

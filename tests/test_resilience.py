@@ -722,9 +722,10 @@ class _FailingPackStore(MemObjectStore):
 
 def _upload_one_pack(repo):
     repo._pl_upload_slots.acquire()
-    repo._upload_pack(b"x" * 16, [{"id": "a" * 64, "type": "data",
-                                   "offset": 0, "length": 16,
-                                   "raw_length": 16}])
+    # segments is a list of sealed-segment iovecs (one part here)
+    repo._upload_pack([[b"x" * 16]], [{"id": "a" * 64, "type": "data",
+                                       "offset": 0, "length": 16,
+                                       "raw_length": 16}])
 
 
 def test_repository_upload_no_retry_stacking():

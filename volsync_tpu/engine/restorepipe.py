@@ -222,9 +222,13 @@ def _execute(tr, repo, cache: PackCache, plans, placements,
             healed[pack_id] = _mirror_heal(repo, cache, pack_id)
         return healed[pack_id]
 
-    def decode_member(body: bytes, blob_id: str, p_off: int, p_len: int,
-                      raw_len: int) -> bytes:
-        data = repo._decode_blob(body[p_off:p_off + p_len])
+    def decode_member(body, blob_id: str, p_off: int, p_len: int,
+                      raw_len: int):
+        # zero-copy slice: the sealed segment decodes straight off the
+        # cached pack body; on the unencrypted+incompressible path
+        # ``data`` stays a memoryview all the way to the positional
+        # file write
+        data = repo._decode_blob(memoryview(body)[p_off:p_off + p_len])
         if len(data) != raw_len:
             raise crypto.IntegrityError(
                 f"restore: blob {blob_id} length "

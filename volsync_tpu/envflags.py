@@ -142,6 +142,17 @@ def no_pallas() -> bool:
     return env_bool("VOLSYNC_NO_PALLAS")
 
 
+def donate_device_inputs() -> Optional[bool]:
+    """VOLSYNC_DONATE tri-state: None when unset — callers fall back to
+    the backend-aware default (donate staged segment buffers into the
+    batched chunk-hash dispatch on TPU, where XLA reuses the donated
+    HBM; skip on CPU, where donation is ignored with a warning) — else
+    the forced bool."""
+    if os.environ.get("VOLSYNC_DONATE") is None:
+        return None
+    return env_bool("VOLSYNC_DONATE")
+
+
 # -- engine worker knobs (engine/backup.py, engine/restore.py) -----------
 
 def backup_workers() -> int:

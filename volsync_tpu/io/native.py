@@ -147,6 +147,39 @@ class ReadaheadReader:
         out, self._carry = self._carry[:n], self._carry[n:]
         return out
 
+    def readinto(self, view) -> int:
+        """Fill ``view`` from the stream, returning bytes written (0 at
+        EOF) — short fills are allowed. The zero-copy segment fill path:
+        one copy from the C++ readahead buffer straight into the
+        caller's pooled segment, no carry-concat round trip (the carry
+        only materializes when a caller mixes read() and readinto() or
+        hands a view smaller than a native segment)."""
+        mv = memoryview(view).cast("B")
+        if len(mv) == 0:
+            return 0
+        if self._carry:
+            take = min(len(self._carry), len(mv))
+            mv[:take] = self._carry[:take]
+            self._carry = self._carry[take:]
+            return take
+        if self._eof:
+            return 0
+        got = self._lib.volio_next(self._handle, self._buf)
+        if got < 0:
+            raise OSError("volio_next failed")
+        if got == 0:
+            self._eof = True
+            return 0
+        take = min(got, len(mv))
+        src = memoryview(self._buf).cast("B")
+        mv[:take] = src[:take]
+        if take < got:
+            self._carry = bytes(src[take:got])
+        from volsync_tpu.obs import record_copy
+
+        record_copy("chunker.ingest", take)
+        return take
+
     def close(self):
         if self._handle:
             self._lib.volio_close(self._handle)

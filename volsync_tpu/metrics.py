@@ -334,6 +334,18 @@ class Metrics:
             "scrub",
             registry=self.registry,
         )
+        # Copy ledger (obs/copyledger.py): host bytes memcpy'd at the
+        # SANCTIONED copy sites of the zero-copy data plane — every
+        # remaining staging copy on the backup/restore hot paths is
+        # wrapped in record_copy(site, n), so copy_ratio (host bytes
+        # copied / payload bytes moved) is measurable and regressions
+        # show up as new sites or growing counts. Site values are the
+        # fixed dotted names listed in docs/performance.md.
+        self.copy_bytes = Counter(
+            "volsync_copy_bytes_total",
+            "Host bytes copied at sanctioned data-plane copy sites",
+            ["site"], registry=self.registry,
+        )
 
     def for_object(self, name: str, namespace: str, role: str,
                    method: str) -> "BoundMetrics":

@@ -163,17 +163,22 @@ class AzureBlobStore:
 
     # -- ObjectStore protocol ----------------------------------------------
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data) -> None:
+        from volsync_tpu.objstore.store import body_bytes
+
         _check_key(key)
         st, body, _ = self._request(
-            "PUT", key, body=data, headers={"x-ms-blob-type": "BlockBlob"})
+            "PUT", key, body=body_bytes(data),
+            headers={"x-ms-blob-type": "BlockBlob"})
         if st not in (201,):
             raise AzureError(st, body)
 
-    def put_if_absent(self, key: str, data: bytes) -> bool:
+    def put_if_absent(self, key: str, data) -> bool:
+        from volsync_tpu.objstore.store import body_bytes
+
         _check_key(key)
         st, body, _ = self._request(
-            "PUT", key, body=data,
+            "PUT", key, body=body_bytes(data),
             headers={"x-ms-blob-type": "BlockBlob", "If-None-Match": "*"})
         if st == 201:
             return True

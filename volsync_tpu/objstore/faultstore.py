@@ -357,13 +357,32 @@ class FaultStore:
 
     # -- ObjectStore protocol ---------------------------------------------
 
-    def put(self, key: str, data: bytes) -> None:
-        data = bytes(data)
-        self._apply("put", key, lambda: self.inner.put(key, data),
-                    torn_execute=lambda: self.inner.put(
-                        key, data[: max(0, len(data) // 2)]))
+    def put(self, key: str, data) -> None:
+        # PutBody-aware: iovec part lists pass through untouched (the
+        # zero-copy seal path); the torn form truncates at the logical
+        # half-length without materializing one blob.
+        from volsync_tpu.objstore.store import body_len, body_parts
 
-    def put_if_absent(self, key: str, data: bytes) -> bool:
+        half = max(0, body_len(data) // 2)
+
+        def torn():
+            out: list = []
+            left = half
+            for p in body_parts(data):
+                if left <= 0:
+                    break
+                if len(p) <= left:
+                    out.append(p)
+                    left -= len(p)
+                else:
+                    out.append(memoryview(p)[:left])
+                    left = 0
+            self.inner.put(key, out)
+
+        self._apply("put", key, lambda: self.inner.put(key, data),
+                    torn_execute=torn)
+
+    def put_if_absent(self, key: str, data) -> bool:
         return self._apply("put_if_absent", key,
                            lambda: self.inner.put_if_absent(key, data))
 

@@ -257,9 +257,14 @@ class S3ObjectStore:
 
     # -- ObjectStore protocol ------------------------------------------------
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data) -> None:
+        # SigV4 hashes the payload, so the transport needs one
+        # contiguous body — body_bytes is the ledger-sanctioned
+        # assemble site for iovec PutBody parts.
+        from volsync_tpu.objstore.store import body_bytes
+
         _check_key(key)
-        status, _, body = self._request("PUT", key, body=bytes(data))
+        status, _, body = self._request("PUT", key, body=body_bytes(data))
         if status not in (200, 201, 204):
             raise S3Error(status, body)
 
@@ -274,9 +279,11 @@ class S3ObjectStore:
         False. Callers must treat False as "the key exists" (and read it
         back) — NOT as "someone else's data is there"; don't build a
         lock/lease on this primitive without an ETag check."""
+        from volsync_tpu.objstore.store import body_bytes
+
         _check_key(key)
         status, _, body = self._request(
-            "PUT", key, body=bytes(data),
+            "PUT", key, body=body_bytes(data),
             headers={"If-None-Match": "*"})
         if status in (200, 201, 204):
             return True

@@ -4,6 +4,17 @@ Keys are slash-separated paths (``data/ab/abcdef...``). Writes are
 atomic (temp file + rename) so a crashed backup never leaves a torn
 object — the repository layer relies on this for its crash-consistency
 story (objects are immutable once visible, like S3 PUTs).
+
+``put``/``put_if_absent`` bodies are a *PutBody*: one buffer (bytes,
+bytearray, memoryview) OR an iovec — a list/tuple of such buffers whose
+logical concatenation is the object. The iovec form is the zero-copy
+seal path's contract: the repository hands the pack down as its sealed
+segment list and NO monolithic pack-body ``bytes`` is ever built on the
+write path. Backends that can scatter-write (the filesystem store's
+``writelines``) consume the parts directly; backends whose transport
+needs one contiguous body (HTTP stores, the in-memory map) materialize
+via ``body_bytes`` — the ledger-sanctioned ``objstore.assemble`` copy
+site (docs/performance.md, "Zero-copy data movement").
 """
 
 from __future__ import annotations
@@ -14,12 +25,46 @@ import time
 
 from volsync_tpu.analysis import lockcheck
 from pathlib import Path
-from typing import Iterator, Optional, Protocol
+from typing import Iterator, Optional, Protocol, Sequence, Union
+
+#: A put() body: one buffer or an iovec of buffers (see module doc).
+PutBody = Union[bytes, bytearray, memoryview, Sequence[Union[
+    bytes, bytearray, memoryview]]]
+
+
+def body_parts(data: PutBody) -> Sequence:
+    """Normalize a PutBody to its buffer parts (no copying)."""
+    if isinstance(data, (list, tuple)):
+        return data
+    return (data,)
+
+
+def body_len(data: PutBody) -> int:
+    """Total byte length of a PutBody (no copying)."""
+    if isinstance(data, (list, tuple)):
+        return sum(len(p) for p in data)
+    return len(data)
+
+
+def body_bytes(data: PutBody) -> bytes:
+    """One contiguous ``bytes`` for a PutBody — the single sanctioned
+    materialization for backends whose transport needs it. Pass-through
+    (copy-free) when the body already IS ``bytes``."""
+    if isinstance(data, bytes):
+        return data
+    from volsync_tpu.obs import record_copy
+
+    if isinstance(data, (list, tuple)):
+        out = b"".join(data)
+    else:
+        out = bytes(data)
+    record_copy("objstore.assemble", len(out))
+    return out
 
 
 class ObjectStore(Protocol):
-    def put(self, key: str, data: bytes) -> None: ...
-    def put_if_absent(self, key: str, data: bytes) -> bool:
+    def put(self, key: str, data: PutBody) -> None: ...
+    def put_if_absent(self, key: str, data: PutBody) -> bool:
         """Atomic create-if-absent; False = the key already exists.
         Required: Repository.init's no-clobber guarantee rests on it."""
         ...
@@ -79,14 +124,17 @@ class FsObjectStore:
         _check_key(key)
         return self.root / key
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data: PutBody) -> None:
         p = self._path(key)
         p.parent.mkdir(parents=True, exist_ok=True)
         tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
-        tmp.write_bytes(data)
+        # writelines scatter-writes the iovec parts straight to the OS —
+        # the seal path's segment list never becomes one Python blob.
+        with open(tmp, "wb") as f:
+            f.writelines(body_parts(data))
         tmp.rename(p)  # atomic visibility
 
-    def put_if_absent(self, key: str, data: bytes) -> bool:
+    def put_if_absent(self, key: str, data: PutBody) -> bool:
         """Atomic create-if-absent (hard link fails if the target
         exists): the primitive Repository.init uses so two movers racing
         to initialize one repository can never clobber each other's
@@ -94,7 +142,8 @@ class FsObjectStore:
         p = self._path(key)
         p.parent.mkdir(parents=True, exist_ok=True)
         tmp = p.parent / f".tmp.{os.getpid()}.{threading.get_ident()}.{p.name}"
-        tmp.write_bytes(data)
+        with open(tmp, "wb") as f:
+            f.writelines(body_parts(data))
         try:
             os.link(tmp, p)
             return True
@@ -174,17 +223,19 @@ class MemObjectStore:
         self._objs: dict[str, bytes] = {}
         self._lock = lockcheck.make_lock("objstore.mem")
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data: PutBody) -> None:
         _check_key(key)
+        body = body_bytes(data)
         with self._lock:
-            self._objs[key] = bytes(data)
+            self._objs[key] = body
 
-    def put_if_absent(self, key: str, data: bytes) -> bool:
+    def put_if_absent(self, key: str, data: PutBody) -> bool:
         _check_key(key)
+        body = body_bytes(data)
         with self._lock:
             if key in self._objs:
                 return False
-            self._objs[key] = bytes(data)
+            self._objs[key] = body
             return True
 
     def get(self, key: str) -> bytes:
@@ -259,7 +310,7 @@ class LatencyStore:
         with self._lock:
             self._active_gets -= 1
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data: PutBody) -> None:
         with self._lock:
             self.puts += 1
             self._active_puts += 1
@@ -273,7 +324,7 @@ class LatencyStore:
             with self._lock:
                 self._active_puts -= 1
 
-    def put_if_absent(self, key: str, data: bytes) -> bool:
+    def put_if_absent(self, key: str, data: PutBody) -> bool:
         if self.put_latency:
             time.sleep(self.put_latency)
         return self.inner.put_if_absent(key, data)

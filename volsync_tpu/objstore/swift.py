@@ -346,15 +346,19 @@ class SwiftObjectStore:
 
     # -- ObjectStore protocol ----------------------------------------------
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data) -> None:
+        from volsync_tpu.objstore.store import body_bytes
+
         _check_key(key)
-        st, body, _ = self._request("PUT", key, body=data)
+        st, body, _ = self._request("PUT", key, body=body_bytes(data))
         if st not in (200, 201):
             raise SwiftError(st, body)
 
-    def put_if_absent(self, key: str, data: bytes) -> bool:
+    def put_if_absent(self, key: str, data) -> bool:
+        from volsync_tpu.objstore.store import body_bytes
+
         _check_key(key)
-        st, body, _ = self._request("PUT", key, body=data,
+        st, body, _ = self._request("PUT", key, body=body_bytes(data),
                                     headers={"If-None-Match": "*"})
         if st in (200, 201):
             return True

@@ -351,6 +351,29 @@ def reset_spans():
 
 # -- flight recorder ------------------------------------------------------
 
+def trace_instant(name: str, **args) -> None:
+    """Thread-scoped instant event (Chrome ``ph="i"``) into the flight
+    recorder when a SAMPLED trace context is active; no-op otherwise.
+    The event lands at the current timestamp on the calling thread, so
+    in Perfetto it nests visually under whatever stage span is open —
+    the copy ledger uses this to attribute sanctioned host copies to
+    the pipeline stage that paid them. Unlike spans these carry no
+    Prometheus cost, so they are safe at per-segment frequency."""
+    ctx = _CTX.get()
+    if ctx is None or not ctx.sampled:
+        return
+    tid = threading.get_ident()
+    with _lock:
+        if tid not in _thread_names:
+            _thread_names[tid] = threading.current_thread().name
+        _ring.append({
+            "name": name, "cat": "copy", "ph": "i", "s": "t",
+            "ts": (time.perf_counter() - _EPOCH) * 1e6,
+            "pid": _PID, "tid": tid,
+            "args": {**args, "trace_id": ctx.trace_id,
+                     "parent_span_id": ctx.span_id}})
+
+
 def trace_events() -> list:
     """Snapshot of the ring buffer (Chrome trace events, oldest first)."""
     with _lock:

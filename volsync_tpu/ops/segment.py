@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from volsync_tpu.obs import record_copy
 from volsync_tpu.ops.gearcdc import GearParams, gear_at_aligned
 from volsync_tpu.ops.sha256 import (
     _H0,
@@ -528,15 +529,12 @@ def chunk_hash_segment(data: jax.Array, valid_len, *, min_size: int,
         roots.reshape(-1)])
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("min_size", "avg_size", "max_size", "seed", "mask_s",
-                     "mask_l", "align", "cand_cap", "chunk_cap"))
-def chunk_hash_segments(data: jax.Array, valid_len: jax.Array,
-                        eof: jax.Array, *, min_size: int, avg_size: int,
-                        max_size: int, seed: int, mask_s: int, mask_l: int,
-                        align: int, cand_cap: int,
-                        chunk_cap: int) -> jax.Array:
+def _chunk_hash_segments_impl(data: jax.Array, valid_len: jax.Array,
+                              eof: jax.Array, *, min_size: int,
+                              avg_size: int, max_size: int, seed: int,
+                              mask_s: int, mask_l: int,
+                              align: int, cand_cap: int,
+                              chunk_cap: int) -> jax.Array:
     """MANY independent segments in ONE device program — the cross-PVC
     batched form of ``chunk_hash_segment`` (BASELINE configs[5]: many
     concurrent relationships share one chip; batching their segments
@@ -638,6 +636,41 @@ def chunk_hash_segments(data: jax.Array, valid_len: jax.Array,
         roots.reshape(S, chunk_cap * 8)], axis=1)
 
 
+_SEGMENTS_STATIC = ("min_size", "avg_size", "max_size", "seed", "mask_s",
+                    "mask_l", "align", "cand_cap", "chunk_cap")
+
+#: normal variant — the staged [S, P] device rows stay alive after the
+#: dispatch (callers that re-read them must use this)
+chunk_hash_segments = functools.partial(
+    jax.jit, static_argnames=_SEGMENTS_STATIC)(_chunk_hash_segments_impl)
+
+#: buffer-donating variant: XLA reuses the [S, P] input rows' HBM for
+#: program outputs/scratch — the batched hasher's staged segments are
+#: write-once, so on TPU donation saves an [S, P]-sized live allocation
+#: per in-flight dispatch. The donated device array is dead afterwards;
+#: the overflow-retry path rebuilds lanes from the HOST rows, never the
+#: donated array. On CPU jax ignores donation (with a warning), which
+#: is why _use_donation defaults by backend.
+chunk_hash_segments_donated = functools.partial(
+    jax.jit, static_argnames=_SEGMENTS_STATIC,
+    donate_argnums=(0,))(_chunk_hash_segments_impl)
+
+
+@functools.lru_cache(maxsize=None)
+def _donation_default() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _use_donation() -> bool:
+    """VOLSYNC_DONATE forced value, else donate exactly on TPU."""
+    from volsync_tpu import envflags
+
+    forced = envflags.donate_device_inputs()
+    if forced is not None:
+        return forced
+    return _donation_default()
+
+
 @functools.partial(jax.jit, static_argnames=("n_pages_pad", "pagemajor"))
 def _page_digests_jit(data, n_pages_pad: int, pagemajor: bool):
     return _page_digests_flat(data, n_pages_pad, pagemajor=pagemajor)
@@ -729,7 +762,7 @@ def decode_segment(packed: np.ndarray, chunk_cap: int
     starts = packed[4: 4 + chunk_cap].astype(np.int64)
     lens = packed[4 + chunk_cap: 4 + 2 * chunk_cap].astype(np.int64)
     roots = packed[4 + 2 * chunk_cap:].reshape(chunk_cap, 8).astype(">u4")
-    out = [(int(starts[c]), int(lens[c]), roots[c].tobytes().hex())
+    out = [(int(starts[c]), int(lens[c]), roots[c].tobytes().hex())  # lint: ignore[VL106] 32 B digests
            for c in range(count)]
     return out, consumed, n_cand, n_leaves
 
@@ -836,12 +869,17 @@ class BatchedSegmentHasher:
         rows = np.zeros((S, P), dtype=np.uint8)
         lens = np.zeros((S,), dtype=np.int32)
         eofs = np.zeros((S,), dtype=bool)
+        staged = 0
         for i, (buf, n, eof) in enumerate(items):
             arr = np.frombuffer(buf, dtype=np.uint8, count=len(buf))
             rows[i, : arr.shape[0]] = arr
+            staged += arr.shape[0]
             lens[i] = n
             eofs[i] = eof
-        packed = np.asarray(chunk_hash_segments(
+        record_copy("device.stage", staged)
+        fn = (chunk_hash_segments_donated if _use_donation()
+              else chunk_hash_segments)
+        packed = np.asarray(fn(
             jnp.asarray(rows), jnp.asarray(lens), jnp.asarray(eofs),
             min_size=p.min_size, avg_size=p.avg_size, max_size=p.max_size,
             seed=p.seed, mask_s=p.mask_s, mask_l=p.mask_l, align=p.align,

@@ -196,7 +196,7 @@ def sha256_pack_host(chunks: list[bytes], pad_batch_to: int | None = None,
         buf[i, L] = 0x80
         bitlen = L * 8
         buf[i, nb[i] * 64 - 8 : nb[i] * 64] = np.frombuffer(
-            np.array([bitlen], dtype=">u8").tobytes(), dtype=np.uint8
+            np.array([bitlen], dtype=">u8").tobytes(), dtype=np.uint8  # lint: ignore[VL106] 8 B length field
         )
     words = buf.reshape(Bp, N, 16, 4).astype(np.uint32)
     blocks = (
@@ -211,7 +211,7 @@ def sha256_pack_host(chunks: list[bytes], pad_batch_to: int | None = None,
 def digest_bytes(digests: np.ndarray) -> list[bytes]:
     """[B, 8] uint32 -> list of 32-byte big-endian digests."""
     d = np.asarray(digests).astype(">u4")
-    return [d[i].tobytes() for i in range(d.shape[0])]
+    return [d[i].tobytes() for i in range(d.shape[0])]  # lint: ignore[VL106] 32 B digests
 
 
 def sha256_many(chunks: list[bytes]) -> list[bytes]:

@@ -44,7 +44,7 @@ def as_key_rows(keys) -> np.ndarray:
             return (np.ascontiguousarray(keys).view(">u8")
                     .astype(np.uint64).reshape(-1, 4))
         if keys.dtype.kind == "S" and keys.dtype.itemsize == 32:
-            return (np.frombuffer(keys.tobytes(), dtype=">u8")
+            return (np.frombuffer(keys.tobytes(), dtype=">u8")  # lint: ignore[VL106] 32 B id rows
                     .astype(np.uint64).reshape(-1, 4))
         raise ValueError(f"unsupported key array {keys.dtype}/{keys.shape}")
     ids = list(keys)
@@ -104,7 +104,7 @@ class CompactIndex:
 
     @staticmethod
     def _hex(row: np.ndarray) -> str:
-        return b"".join(int(w).to_bytes(8, "big") for w in row).hex()
+        return b"".join(int(w).to_bytes(8, "big") for w in row).hex()  # lint: ignore[VL106] one 32 B id
 
     # -- internals ----------------------------------------------------------
 
@@ -389,7 +389,7 @@ class CompactIndex:
         uses for whole-index liveness math without touching per-entry
         Python objects."""
         rows = np.nonzero(self._pack[: self._n] != _DEAD_PACK)[0]
-        kb = self._keys[rows].astype(">u8").tobytes()
+        kb = self._keys[rows].astype(">u8").tobytes()  # lint: ignore[VL106] index metadata, not payload
         keys = np.frombuffer(kb, dtype="S32")
         return keys, self._pack[rows].copy(), list(self._packs)
 

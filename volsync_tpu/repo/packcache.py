@@ -171,11 +171,15 @@ class PackCache:
             return True
 
     def get_ranges(self, pack_id: str,
-                   spans: list[tuple[int, int]]) -> list[bytes]:
+                   spans: list[tuple[int, int]]) -> list[memoryview]:
         """Coalesced ranged read: ONE pack fetch serves every
         ``(offset, length)`` span — the planner's per-pack blob list
-        rides this instead of per-blob ``get_range`` round trips."""
-        body = self.get_pack(pack_id)
+        rides this instead of per-blob ``get_range`` round trips.
+
+        Returns zero-copy read-only memoryview slices of the cached
+        body (safe: pack bodies are immutable ``bytes``; a view pins
+        the body alive past eviction, which only delays the free)."""
+        body = memoryview(self.get_pack(pack_id)).toreadonly()
         return [body[off:off + length] for off, length in spans]
 
     # -- introspection -----------------------------------------------------

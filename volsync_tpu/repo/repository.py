@@ -49,7 +49,7 @@ from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
 from volsync_tpu.objstore.store import NoSuchKey, ObjectStore
-from volsync_tpu.obs import carry_context, record_trigger, span
+from volsync_tpu.obs import carry_context, record_copy, record_trigger, span
 from volsync_tpu.repo import blobid, crypto
 from volsync_tpu.repo.shardedindex import ShardedBlobIndex
 from volsync_tpu.repo.compress import Compressor, Decompressor
@@ -175,12 +175,14 @@ class _OpenBlob:
 
 @dataclass
 class _InflightPack:
-    """A closed pack whose upload is in flight. ``entries``/``body`` are
-    retained until the reap so buffered reads and a mid-run load_index
-    can still see its blobs (they stay pack="" in the index until the
-    put completes)."""
+    """A closed pack whose upload is in flight. ``entries``/``segments``
+    are retained until the reap so buffered reads and a mid-run
+    load_index can still see its blobs (they stay pack="" in the index
+    until the put completes). ``segments[i]`` is the sealed iovec for
+    ``entries[i]`` — the pack body is their logical concatenation and
+    is never materialized here (the zero-copy seal path)."""
     entries: list[dict]
-    body: bytes
+    segments: list[list]
     fut: Future           # resolves to (pack_id, pack_bytes_len)
 
 
@@ -233,7 +235,11 @@ class Repository:
         # dedup queries (has_blobs) need no repo.state acquisition.
         self._index = ShardedBlobIndex()
         self._lock = lockcheck.make_rlock("repo.state")
-        self._cur_segments: list[bytes] = []
+        # Open-pack buffer: _cur_segments[i] is the sealed IOVEC (list
+        # of bytes/memoryview parts from seal_parts) for
+        # _cur_entries[i]; the pack body stays scattered until the
+        # store consumes it (ObjectStore.put's PutBody contract).
+        self._cur_segments: list[list] = []
         self._cur_entries: list[dict] = []
         self._cur_size = 0
         self._pending_index: dict[str, list[dict]] = {}
@@ -850,12 +856,34 @@ class Repository:
 
     # -- write path ---------------------------------------------------------
 
-    def _encode_blob(self, data: bytes) -> bytes:
+    def _encode_blob(self, data) -> list:
+        """Seal one blob into its sealed-segment IOVEC (list of
+        bytes/memoryview parts whose concatenation is the sealed
+        segment). ``data`` is any buffer — the chunker's pooled
+        memoryviews flow through compress/seal_parts uncopied; on the
+        PlainBox + incompressible path the caller's view itself becomes
+        a part and rides down to the store PUT."""
         with span("repo.seal"):
             comp = self._zc.compress(data)
             if len(comp) <= len(data) * _COMPRESS_MIN_GAIN:
-                return self.box.seal(b"\x01" + comp)
-            return self.box.seal(b"\x00" + data)
+                return self.box.seal_parts((b"\x01", comp))
+            return self.box.seal_parts((b"\x00", data))
+
+    @staticmethod
+    def _seg_len(seg: list) -> int:
+        """Stored length of a sealed-segment iovec (no copying)."""
+        return sum(len(p) for p in seg)
+
+    @staticmethod
+    def _seg_join(seg: list) -> bytes:
+        """One contiguous buffer for a sealed-segment iovec — only the
+        buffered-read path (reading a blob still in the write pipeline)
+        needs this; pack upload and decode stream the parts."""
+        if len(seg) == 1:
+            return seg[0]
+        out = b"".join(seg)  # lint: ignore[VL106] ledgered copy
+        record_copy("repo.buffered_read", len(out))
+        return out
 
     @property
     def _zc(self):
@@ -969,20 +997,21 @@ class Repository:
             self._pl_reap(block=False)
             return
         seg = self._encode_blob(data)
+        stored = self._seg_len(seg)
         self._cur_entries.append({
             "id": blob_id, "type": btype, "offset": self._cur_size,
-            "length": len(seg), "raw_length": len(data),
+            "length": stored, "raw_length": len(data),
         })
         self._cur_segments.append(seg)
-        self._cur_size += len(seg)
+        self._cur_size += stored
         # visible to dedup immediately (pack id filled at flush)
         self._index.insert(blob_id, "", btype,
-                           self._cur_entries[-1]["offset"], len(seg),
+                           self._cur_entries[-1]["offset"], stored,
                            len(data))
         if stats:
             stats.blobs_new += 1
             stats.bytes_new += len(data)
-            stats.bytes_stored += len(seg)
+            stats.bytes_stored += stored
         if self._cur_size >= self.PACK_TARGET:
             self._flush_pack()
 
@@ -1006,18 +1035,19 @@ class Repository:
         lockcheck.assert_held(self._lock, "repo seal queue (_pl_open)")
         ob = self._pl_open.pop(0)
         seg = ob.fut.result()
+        stored = self._seg_len(seg)
         self._cur_entries.append({
             "id": ob.meta["id"], "type": ob.meta["type"],
-            "offset": self._cur_size, "length": len(seg),
+            "offset": self._cur_size, "length": stored,
             "raw_length": ob.meta["raw_length"],
         })
         self._cur_segments.append(seg)
-        self._cur_size += len(seg)
+        self._cur_size += stored
         self._index.insert(ob.meta["id"], "", ob.meta["type"],
-                           self._cur_entries[-1]["offset"], len(seg),
+                           self._cur_entries[-1]["offset"], stored,
                            ob.meta["raw_length"])
         if ob.stats:
-            ob.stats.bytes_stored += len(seg)
+            ob.stats.bytes_stored += stored
         self._g_seal.set(len(self._pl_open))
         if self._cur_size >= self.PACK_TARGET:
             self._pl_close_pack()
@@ -1033,13 +1063,13 @@ class Repository:
         lockcheck.assert_held(self._lock, "open pack buffer (_cur_*)")
         if not self._cur_segments:
             return
-        body = b"".join(self._cur_segments)
+        segments = self._cur_segments
         entries = self._cur_entries
         self._cur_segments, self._cur_entries, self._cur_size = [], [], 0
         self._pl_upload_slots.acquire()
         try:
             fut = _get_upload_pool().submit(
-                carry_context(self._upload_pack), body, entries)
+                carry_context(self._upload_pack), segments, entries)
         except BaseException:
             # on the success path _upload_pack's finally releases the
             # slot; if the submit itself fails, no worker ever runs,
@@ -1047,28 +1077,39 @@ class Repository:
             self._pl_upload_slots.release()
             raise
         self._pl_inflight.append(
-            _InflightPack(entries=entries, body=body, fut=fut))
+            _InflightPack(entries=entries, segments=segments, fut=fut))
         self._g_upload.set(len(self._pl_inflight))
         self._pl_reap(block=False)
 
-    def _upload_pack(self, body: bytes, entries: list[dict]) -> str:
+    def _upload_pack(self, segments: list[list],
+                     entries: list[dict]) -> str:
         """Upload worker: seal the header, hash the pack, put with
         retry/backoff. Runs on the upload pool; touches no repository
-        state and never takes self._lock."""
+        state and never takes self._lock.
+
+        Vectored: the pack is the flattened iovec of every sealed
+        segment's parts plus header/trailer — sha256 streams over the
+        parts and the store PUT consumes them directly (PutBody), so no
+        monolithic pack-body ``bytes`` is ever built on this path."""
         try:
             header = self.box.seal(
                 self._zc.compress(json.dumps(entries).encode()))
-            blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
-            pack_id = hashlib.sha256(blob).hexdigest()
+            parts = [p for seg in segments for p in seg]
+            parts.append(header)
+            parts.append(len(header).to_bytes(4, "big") + b"VTPK")
+            h = hashlib.sha256()
+            for p in parts:
+                h.update(p)
+            pack_id = h.hexdigest()
             with span("repo.pack_upload"):
-                self._put_pack_blob(pack_key(pack_id), blob)
+                self._put_pack_blob(pack_key(pack_id), parts)
                 if self.pack_copies >= 2:
-                    self._put_pack_blob(mirror_key(pack_id), blob)
+                    self._put_pack_blob(mirror_key(pack_id), parts)
             return pack_id
         finally:
             self._pl_upload_slots.release()
 
-    def _put_pack_blob(self, key: str, blob: bytes) -> None:
+    def _put_pack_blob(self, key: str, blob) -> None:
         """One pack-copy PUT under exactly one retry layer: the store's
         own (ResilientStore) when it carries one, _upload_policy
         otherwise — the no-stacking rule from the constructor. The
@@ -1119,14 +1160,17 @@ class Repository:
         blob's future), or an in-flight pack's body."""
         for e, seg in zip(self._cur_entries, self._cur_segments):
             if e["id"] == blob_id:
-                return seg
+                return self._seg_join(seg)
         for ob in self._pl_open:
             if ob.meta["id"] == blob_id:
-                return ob.fut.result()
+                return self._seg_join(ob.fut.result())
         for pk in self._pl_inflight:
-            for e in pk.entries:
+            # entries[i] <-> segments[i] stay 1:1 aligned, so the blob's
+            # sealed segment comes straight off the list — no slicing a
+            # materialized pack body
+            for e, seg in zip(pk.entries, pk.segments):
                 if e["id"] == blob_id:
-                    return pk.body[e["offset"]:e["offset"] + e["length"]]
+                    return self._seg_join(seg)
         return None
 
     def _flush_pack(self):
@@ -1139,16 +1183,20 @@ class Repository:
             return
         if not self._cur_segments:
             return
-        body = b"".join(self._cur_segments)
         header = self.box.seal(
             self._zc.compress(json.dumps(self._cur_entries).encode())
         )
-        blob = body + header + len(header).to_bytes(4, "big") + b"VTPK"
-        pack_id = hashlib.sha256(blob).hexdigest()
+        parts = [p for seg in self._cur_segments for p in seg]
+        parts.append(header)
+        parts.append(len(header).to_bytes(4, "big") + b"VTPK")
+        h = hashlib.sha256()
+        for p in parts:
+            h.update(p)
+        pack_id = h.hexdigest()
         with span("repo.pack_upload"):
-            self.store.put(pack_key(pack_id), blob)
+            self.store.put(pack_key(pack_id), parts)
             if self.pack_copies >= 2:
-                self.store.put(mirror_key(pack_id), blob)
+                self.store.put(mirror_key(pack_id), parts)
         for e in self._cur_entries:
             cur = self._index.lookup(e["id"])
             if (cur is None or cur[0] == ""
@@ -1380,7 +1428,7 @@ class Repository:
         # u8-row extraction: S-dtype scalar conversion strips trailing
         # NUL bytes (~1/256 ids end in 0x00 and would truncate).
         rows = keys.view(np.uint8).reshape(-1, 32)
-        return {rows[i].tobytes().hex() for i in range(rows.shape[0])}
+        return {rows[i].tobytes().hex() for i in range(rows.shape[0])}  # lint: ignore[VL106] 32 B ids
 
     def _referenced_keys(self):
         """Reachable blob ids as a SORTED (N,) ``S32`` numpy array of
@@ -1406,7 +1454,7 @@ class Repository:
                         ids += bytes.fromhex(b)
         if not ids:
             return np.empty((0,), dtype="S32")
-        return np.unique(np.frombuffer(bytes(ids), dtype="S32"))
+        return np.unique(np.frombuffer(bytes(ids), dtype="S32"))  # lint: ignore[VL106] id table freeze
 
     def _resolve_grace(self, grace_seconds: Optional[float]) -> float:
         """Precedence: explicit argument, VOLSYNC_PRUNE_GRACE_S, then
@@ -1652,12 +1700,12 @@ class Repository:
             if code is None:
                 continue  # no index entries left for this pack
             rows = pack_rows(code)
-            live_ids = [keys_u8[r].tobytes().hex() for r in rows
+            live_ids = [keys_u8[r].tobytes().hex() for r in rows  # lint: ignore[VL106] 32 B ids
                         if live_mask[r]]
             if live_ids:
                 work[pack] = live_ids
                 rescued += len(live_ids)
-            doomed[pack] = [keys_u8[r].tobytes().hex() for r in rows
+            doomed[pack] = [keys_u8[r].tobytes().hex() for r in rows  # lint: ignore[VL106] 32 B ids
                             if not live_mask[r]]
         # Partially-dead packs become this round's new victims: live
         # blobs rewritten now, dead ENTRIES retained until the sweep (a
@@ -1667,7 +1715,7 @@ class Repository:
             name = pack_names[code]
             new_victims.add(name)
             rows = pack_rows(code)
-            live_ids = [keys_u8[r].tobytes().hex() for r in rows
+            live_ids = [keys_u8[r].tobytes().hex() for r in rows  # lint: ignore[VL106] 32 B ids
                         if live_mask[r]]
             if live_ids:
                 work[name] = live_ids
@@ -1695,7 +1743,7 @@ class Repository:
             for pack in sorted(new_victims):
                 code = code_of.get(pack)
                 rows = pack_rows(code) if code is not None else []
-                doomed[pack] = [keys_u8[r].tobytes().hex()
+                doomed[pack] = [keys_u8[r].tobytes().hex()  # lint: ignore[VL106] 32 B ids
                                 for r in rows if not live_mask[r]]
             sweep_packs |= new_victims
             new_victims = set()

@@ -441,6 +441,8 @@ def reset_link_totals() -> None:
 def _payload_bytes(op: str, args: tuple, kwargs: dict, result) -> int:
     if op == "put":
         data = args[1] if len(args) > 1 else kwargs.get("data", b"")
+        if isinstance(data, (list, tuple)):  # iovec PutBody
+            return sum(len(p) for p in data)
         return len(data)
     return len(result) if isinstance(result, (bytes, bytearray)) else 0
 

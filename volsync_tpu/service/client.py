@@ -21,7 +21,8 @@ from typing import Callable, Iterator, Optional
 
 import grpc
 
-from volsync_tpu.obs import begin_span, format_trace_header, new_id, new_trace
+from volsync_tpu.obs import (begin_span, format_trace_header, new_id,
+                             new_trace, record_copy)
 from volsync_tpu.resilience import RetryPolicy, ThrottleError
 from volsync_tpu.service import moverjax_pb2 as pb
 from volsync_tpu.service.server import (
@@ -150,6 +151,12 @@ class MoverJaxClient:
                 if not piece:
                     yield pb.DataSegment(data=b"", eof=True)
                     return
+                if not isinstance(piece, bytes):
+                    # protobuf bytes fields reject memoryview — the
+                    # wire frame is the one sanctioned materialization
+                    # on this path
+                    piece = bytes(piece)
+                    record_copy("svc.frame", len(piece))
                 yield pb.DataSegment(data=piece)
 
         call = self._chunk_hash(segments(), metadata=meta,
@@ -168,12 +175,15 @@ class MoverJaxClient:
         finally:
             handle.finish("ok" if ok else "error")
 
-    def chunk_bytes(self, data: bytes) -> list[tuple[int, int, str]]:
-        view = memoryview(data)
+    def chunk_bytes(self, data) -> list[tuple[int, int, str]]:
+        """Chunk one in-memory buffer (bytes/bytearray/memoryview).
+        The reader serves zero-copy memoryview slices; the only copy
+        left on this path is the wire frame (see chunk_stream)."""
+        view = memoryview(data).toreadonly()
         pos = [0]
 
-        def read(n: int) -> bytes:
-            piece = bytes(view[pos[0]: pos[0] + n])
+        def read(n: int):
+            piece = view[pos[0]: pos[0] + n]
             pos[0] += len(piece)
             return piece
 

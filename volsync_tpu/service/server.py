@@ -45,6 +45,7 @@ from __future__ import annotations
 import hmac
 import logging
 import os
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
@@ -53,7 +54,7 @@ import numpy as np
 
 from volsync_tpu import envflags
 from volsync_tpu.obs import begin_span, new_trace, parse_trace_header, \
-    use_context
+    record_copy, use_context
 from volsync_tpu.ops.batcher import BatcherStopped, SegmentMicroBatcher
 from volsync_tpu.service import moverjax_pb2 as pb
 from volsync_tpu.service.admission import (
@@ -379,15 +380,38 @@ class MoverJaxServer:
         control pauses the sender, and server-side buffering stays
         bounded no matter how slow the device or how greedy the
         client."""
-        pending = bytearray()  # amortized append; bytes += would be O(n^2)
+        # gRPC frames buffered UNJOINED: each pb frame is immutable
+        # bytes, so the rolling buffer is a deque of them plus a
+        # consumed-prefix offset into the head frame. The old bytearray
+        # paid two full copies per segment (append into the rolling
+        # buffer, then a bytes() snapshot at flush); this pays at most
+        # one — the assemble join — and zero for single-frame segments.
+        pieces: deque = deque()
+        head = 0          # consumed prefix of pieces[0]
+        plen = 0          # logical bytes buffered
         base = 0
         p = self.params
         cut = self.segment_size + p.max_size
         credit_bytes = self._stream_credits * cut
         inflight: Optional[tuple[Future, bool]] = None
 
+        def assemble():
+            # one snapshot of the WHOLE buffer: frames are immutable,
+            # so views/joins over them are stable while the device
+            # works and later appends don't disturb the consumed prefix
+            if not pieces:
+                return b""
+            if len(pieces) == 1:
+                if head == 0:
+                    return pieces[0]  # zero-copy pass-through
+                return memoryview(pieces[0])[head:]
+            out = b"".join([memoryview(pieces[0])[head:],
+                            *list(pieces)[1:]])
+            record_copy("svc.frame", len(out))
+            return out
+
         def collect(handle) -> pb.ChunkBatch:
-            nonlocal base
+            nonlocal base, head, plen
             fut, eof = handle
             out, _ = fut.result(timeout=600)
             batch = pb.ChunkBatch(final=eof)
@@ -397,31 +421,42 @@ class MoverJaxServer:
                     offset=base + start, length=length, digest=digest))
                 consumed = start + length
             base += consumed
-            del pending[:consumed]  # keep only the carried tail
+            # drop the consumed prefix frame by frame; only the head
+            # frame's offset moves — no bytes shift
+            plen -= consumed
+            drop = consumed
+            while drop:
+                avail = len(pieces[0]) - head
+                if avail <= drop:
+                    pieces.popleft()
+                    head = 0
+                    drop -= avail
+                else:
+                    head += drop
+                    drop = 0
             return batch
 
         def flush(eof: bool) -> tuple[Future, bool]:
-            # a snapshot of the WHOLE buffer: appends that land while
-            # the device works don't disturb the consumed prefix
-            return (self._submit_segment(ticket, bytes(pending), eof), eof)
+            return (self._submit_segment(ticket, assemble(), eof), eof)
 
         for seg in request_iterator:
             if seg.data:
-                pending += seg.data
+                pieces.append(seg.data)
+                plen += len(seg.data)
             if inflight is not None and inflight[0].done():
                 yield collect(inflight)
                 inflight = None
-            if inflight is None and len(pending) >= cut:
+            if inflight is None and plen >= cut:
                 inflight = flush(False)
-            while inflight is not None and len(pending) >= credit_bytes:
+            while inflight is not None and plen >= credit_bytes:
                 # credits exhausted: stop reading, wait out the device
                 yield collect(inflight)
                 inflight = None
-                if len(pending) >= cut:
+                if plen >= cut:
                     inflight = flush(False)
             if inflight is not None:
                 ticket.buffered_high_water = max(
-                    ticket.buffered_high_water, len(pending))
+                    ticket.buffered_high_water, plen)
             if seg.eof:
                 if inflight is not None:
                     yield collect(inflight)
