@@ -2,19 +2,26 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint test chaos chaos-concurrent chaos-fleet chaos-restore \
-	chaos-scrub scrub-smoke static-check bench-index-smoke \
-	service-bench-smoke fleet-bench-smoke restore-bench-smoke \
-	copies-smoke syncplan-bench-smoke trace-smoke session-smoke \
-	clean-lint
+.PHONY: lint lint-locks test chaos chaos-concurrent chaos-fleet \
+	chaos-restore chaos-scrub scrub-smoke static-check \
+	bench-index-smoke service-bench-smoke fleet-bench-smoke \
+	restore-bench-smoke copies-smoke syncplan-bench-smoke \
+	trace-smoke session-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105/VL106 + VL301 per-file + VL101-VL104
-# interprocedural + VL201-VL205 shape/dtype abstract interpretation, no
-# baseline. Warm runs re-analyze zero files; see docs/development.md.
+# interprocedural + VL201-VL205 shape/dtype abstract interpretation +
+# VL401-VL404 static concurrency, no baseline. Warm runs re-analyze
+# zero files; see docs/development.md.
 lint:
 	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
 	    --no-baseline --format sarif --out lint.sarif --cache .lint-cache
+
+# Just the static concurrency family (VL401-VL404), with the lock
+# acquisition-order graph exported for inspection.
+lint-locks:
+	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
+	    --no-baseline --select VL4 --dump-lock-graph lock-graph.json
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -218,7 +218,8 @@ def run_closed_loop(*, tenants: list[dict], requests_per_client: int = 3,
                     tenant_streams: int = 0, max_queued: int = 0,
                     stream_credits: int = 0, force_breaker: bool = False,
                     fault_spec: str = "", fault_seed: int = 0,
-                    params=None, warm: bool = True) -> dict:
+                    params=None, warm: bool = True,
+                    client_timeout: float = 60.0) -> dict:
     """The importable benchmark core (the acceptance test drives it
     directly). ``tenants`` is [{name, weight, clients[, streams]}, ...];
     0 for any cap means "use the VOLSYNC_SVC_* default"."""
@@ -277,7 +278,7 @@ def run_closed_loop(*, tenants: list[dict], requests_per_client: int = 3,
 
     def make_client(tenant: str) -> MoverJaxClient:
         return MoverJaxClient("127.0.0.1", srv.port, srv.token,
-                              tenant=tenant)
+                              tenant=tenant, timeout=client_timeout)
 
     result: dict = {
         "metric": "service_closed_loop",
@@ -378,12 +379,21 @@ def _breaker_shed_phase(srv, make_client) -> dict:
     }
 
 
-# The non-overlapping server-side components of one stream: admission
-# gate, DRR queue wait, device batch (svc.schedule and svc.stream
-# enclose/overlap these, client.chunk_stream is the client's view —
-# all reported in stages_s but excluded from the coverage sum so no
-# second is counted twice).
-_COMPONENT_STAGES = ("svc.admit", "svc.queue_wait", "svc.batch")
+# The components of one stream: admission gate, client-paced frame
+# pulls, DRR queue wait, device batch, client-paced batch drains
+# (svc.schedule and svc.stream enclose/overlap these,
+# client.chunk_stream is the client's view — all reported in stages_s
+# but excluded from the coverage sum so no second is counted twice).
+_COMPONENT_STAGES = ("svc.admit", "svc.ingest", "svc.queue_wait",
+                     "svc.batch", "svc.emit")
+# Coverage is components / svc.stream — the span that encloses them on
+# the server — NOT components / client p50: the client number includes
+# client-side work no server span can account for. svc.ingest and
+# svc.emit matter for the same reason: the handler blocks on the
+# client inside svc.stream, so under a saturated CPU those waits
+# dominate and, uninstrumented, they flaked this gate (bronze
+# coverage 0.74). Credit-based read-ahead lets svc.queue_wait /
+# svc.batch overlap the client waits, so coverage can exceed 1.0.
 
 
 def _report_load_phase(tenants: list[dict], tallies: dict, wall: float,
@@ -418,10 +428,12 @@ def _report_load_phase(tenants: list[dict], tallies: dict, wall: float,
             # where each tenant's time went (seconds summed over the
             # timed phase, from the tenant-tagged span registry)
             "stages_s": stages,
-            # mean per-request component time over the measured p50:
-            # >= 0.9 means the breakdown accounts for the latency
-            "stage_coverage": round(comp / tl.requests / p50_s, 3)
-            if tl.requests and p50_s > 0 else 0.0,
+            # component seconds over the enclosing server-span
+            # seconds: >= 0.9 means the breakdown accounts for the
+            # server-side latency (see _COMPONENT_STAGES comment)
+            "stage_coverage": round(
+                comp / stages["svc.stream"], 3)
+            if stages.get("svc.stream", 0.0) > 0 else 0.0,
         }
     segments = sum(dispatch_log)
     return {

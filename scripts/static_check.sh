@@ -7,12 +7,15 @@
 #      (ad-hoc retry sleeps outside resilience.py), VL106 (hot-path
 #      byte copies outside the sanctioned copy-ledger sites) and VL301
 #      (span names must be literal dotted lowercase), the interprocedural
-#      VL101-VL104 family, and the VL201-VL205
-#      shape/dtype abstract interpreter
+#      VL101-VL104 family, the VL201-VL205
+#      shape/dtype abstract interpreter, and the VL401-VL404 static
+#      concurrency family (lock-order cycle proofs, guarded-field race
+#      inference, check-then-act, unsynchronized publication)
 #      (tests/test_analysis.py enforces the same in tier-1). Emits a
 #      SARIF 2.1.0 report to lint.sarif for CI upload and uses the
-#      content-hash incremental cache (.lint-cache): a warm run
-#      re-analyzes zero files.
+#      content-hash incremental cache (.lint-cache): an immediate
+#      second run ASSERTS the warm cache re-analyzes zero files, so
+#      the cached lock/shape summary plumbing can't silently regress.
 #   2. The pipeline + crash-recovery suites with the lock-order/race
 #      detector armed at process start (VOLSYNC_TPU_LOCKCHECK=1), so
 #      module-level locks are instrumented too.
@@ -83,6 +86,15 @@ cd "$(dirname "$0")/.."
 echo "== volsync lint =="
 python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
     --no-baseline --format sarif --out lint.sarif --cache .lint-cache
+
+echo "== volsync lint (warm cache must re-analyze zero files) =="
+warm=$(python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
+    --no-baseline --cache .lint-cache)
+echo "$warm" | grep -q "cache: analyzed 0 of" || {
+    echo "warm lint cache re-analyzed files on an unchanged tree:" >&2
+    echo "$warm" >&2
+    exit 1
+}
 
 echo "== lockcheck-armed pipeline suites =="
 JAX_PLATFORMS=cpu VOLSYNC_TPU_LOCKCHECK=1 \

@@ -1,0 +1,3 @@
+"""VL4xx concurrency fixtures: each module seeds one rule's true
+positive next to a clean twin. Deliberately violating; linted by
+tests, never imported."""

@@ -1,0 +1,65 @@
+"""VL402 fixture: a majority-guarded field with one unguarded access
+on a thread path, an inherited-lock subclass repeating the mistake, a
+reviewed suppression, and a fully-guarded clean twin. Deliberately
+violating; linted by tests, never imported."""
+
+import threading
+
+
+def make_lock(name):
+    return name
+
+
+class Tally:
+    def __init__(self):
+        self._lock = make_lock("fix.fields.tally")
+        self.value = 0
+
+    def start(self):
+        threading.Thread(target=self._poll).start()  # lint: ignore[VL102] — fixture seam
+
+    def _poll(self):
+        self.peek()
+        self.audit()
+
+    def bump(self):
+        with self._lock:
+            self.value = self.value + 1
+
+    def reset(self):
+        with self._lock:
+            self.value = 0
+
+    def peek(self):
+        return self.value  # MARK: unguarded-read
+
+    def audit(self):
+        return self.value  # lint: ignore[VL402] — fixture: reviewed
+
+
+class Meter(Tally):
+    """The lock lives on the base class; the guard (and the miss)
+    resolve through inheritance."""
+
+    def watch(self):
+        threading.Thread(target=self.glance).start()  # lint: ignore[VL102] — fixture seam
+
+    def drain(self):
+        with self._lock:
+            self.value = 0
+
+    def glance(self):
+        return self.value  # MARK: inherited-unguarded
+
+
+class CleanTally:
+    def __init__(self):
+        self._lock = make_lock("fix.fields.clean")
+        self.value = 0
+
+    def start(self):
+        threading.Thread(target=self._poll).start()  # lint: ignore[VL102] — fixture seam
+
+    def _poll(self):
+        with self._lock:
+            self.value = self.value + 1

@@ -1,0 +1,37 @@
+"""VL401 fixture: a two-lock ABBA cycle inside one module, plus a
+clean pair that always nests in one consistent order. Deliberately
+violating; linted by tests, never imported."""
+
+
+def make_lock(name):
+    return name
+
+
+_A = make_lock("fix.order.a")
+_B = make_lock("fix.order.b")
+_C = make_lock("fix.order.c")
+
+
+def ab():
+    with _A:
+        with _B:  # MARK: ab-edge
+            pass
+
+
+def ba():
+    with _B:
+        with _A:  # MARK: ba-edge
+            pass
+
+
+def ca_ok():
+    with _C:
+        with _A:
+            pass
+
+
+def ca_again_ok():
+    # same order as ca_ok: consistent nesting is not a cycle
+    with _C:
+        with _A:
+            pass

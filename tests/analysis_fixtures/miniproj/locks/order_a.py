@@ -1,0 +1,26 @@
+"""VL401 interprocedural fixture, half one: holds the FIRST lock and
+reaches the SECOND through two call hops into order_b. Deliberately
+violating; linted by tests, never imported."""
+
+from miniproj.locks.order_b import grab_second
+
+
+def make_lock(name):
+    return name
+
+
+_FIRST = make_lock("fix.hop.first")
+
+
+def hold_first_call_out():
+    with _FIRST:
+        step_out()  # MARK: hop-out
+
+
+def step_out():
+    grab_second()
+
+
+def grab_first():
+    with _FIRST:
+        pass

@@ -1,0 +1,27 @@
+"""VL401 interprocedural fixture, half two: holds the SECOND lock and
+reaches the FIRST back through order_a — closing a cycle no single
+module shows. Deliberately violating; linted by tests, never
+imported."""
+
+from miniproj.locks.order_a import grab_first
+
+
+def make_lock(name):
+    return name
+
+
+_SECOND = make_lock("fix.hop.second")
+
+
+def grab_second():
+    with _SECOND:
+        pass
+
+
+def hold_second_call_back():
+    with _SECOND:
+        relay()  # MARK: hop-back
+
+
+def relay():
+    grab_first()

@@ -1,0 +1,47 @@
+"""VL404 fixture: a mutable dict published across a thread seam with
+no guard anywhere, and the clean twin that routes every access
+through the class lock. Deliberately violating; linted by tests,
+never imported."""
+
+import threading
+
+
+def make_lock(name):
+    return name
+
+
+class Board:
+    def __init__(self):
+        self.notes = {}  # MARK: unsynced-dict
+
+    def start(self):
+        threading.Thread(target=self._pump).start()  # lint: ignore[VL102] — fixture seam
+
+    def _pump(self):
+        self.post("k", 1)
+
+    def post(self, key, val):
+        self.notes[key] = val
+
+    def read(self, key):
+        return self.notes.get(key)
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = make_lock("fix.publish.ledger")
+        self.rows = {}
+
+    def start(self):
+        threading.Thread(target=self._pump).start()  # lint: ignore[VL102] — fixture seam
+
+    def _pump(self):
+        self.post("k", 1)
+
+    def post(self, key, val):
+        with self._lock:
+            self.rows[key] = val
+
+    def read(self, key):
+        with self._lock:
+            return self.rows.get(key)

@@ -1,0 +1,29 @@
+"""VL403 fixture: a snapshot read under the lock, the lock released,
+and a dependent write re-acquiring it — next to the clean twin that
+keeps check and act in one critical section. Deliberately violating;
+linted by tests, never imported."""
+
+
+def make_lock(name):
+    return name
+
+
+class Budget:
+    def __init__(self):
+        self._lock = make_lock("fix.toctou.budget")
+        self.left = 8
+
+    def spend(self, n):
+        with self._lock:
+            cur = self.left  # MARK: stale-snapshot
+        if cur >= n:
+            with self._lock:
+                self.left = cur - n  # MARK: stale-write
+        return cur
+
+    def spend_ok(self, n):
+        with self._lock:
+            cur = self.left
+            if cur >= n:
+                self.left = cur - n
+        return cur

@@ -28,7 +28,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="Repo-invariant AST lint for volsync-tpu "
                     "(per-file rules VL001-VL005, VL105 and VL301, "
                     "interprocedural rules VL101-VL104, shape/dtype "
-                    "rules VL201-VL205; see docs/development.md)")
+                    "rules VL201-VL205, static concurrency rules "
+                    "VL401-VL404; see docs/development.md)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to lint (default: the installed "
@@ -65,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--ignore", default=None, metavar="PREFIXES",
         help="comma-separated rule-code prefixes to skip; applied "
              "after --select")
+    parser.add_argument(
+        "--dump-lock-graph", default=None, metavar="FILE",
+        help="also write the static lock-acquisition-order graph "
+             "(VL401's evidence: nodes=lock names, edges with hop "
+             "chains) to FILE as JSON, '-' for stdout")
     return parser
 
 
@@ -120,6 +126,19 @@ def main(argv: Optional[list] = None, out=print) -> int:
                          cache_path=Path(args.cache) if args.cache
                          else None)
     findings, errors = result.findings, result.errors
+
+    if args.dump_lock_graph:
+        from volsync_tpu.analysis.lockflow import dump_for_paths
+
+        graph = dump_for_paths(paths)
+        text = json.dumps(graph, indent=2, sort_keys=True)
+        if args.dump_lock_graph == "-":
+            out(text)
+        else:
+            Path(args.dump_lock_graph).write_text(text + "\n",
+                                                  encoding="utf-8")
+            out(f"wrote lock graph to {args.dump_lock_graph} "
+                f"({len(graph['edges'])} edge(s))")
 
     baseline_path = Path(args.baseline) if args.baseline else Path(
         DEFAULT_BASELINE)

@@ -277,6 +277,18 @@ def run_project(paths: Iterable[str],
 
         shape_sum = summaries_for(index)
 
+    # lock facts (acquisition sites, order edges, guarded-field stats)
+    # are the VL4xx analogue of the shape summaries: cached per file so
+    # a warm run replays them without rebuilding the lock model
+    lock_sum: dict = {}
+    if any(str(getattr(r, "code", "")).startswith("VL4")
+           for r in project_rules):
+        from volsync_tpu.analysis.lockflow import (
+            summaries_for as lock_summaries,
+        )
+
+        lock_sum = lock_summaries(index)
+
     findings: list[Finding] = []
     new_cache: dict[str, dict] = {}
     for relpath in sorted(parsed):
@@ -284,11 +296,14 @@ def run_project(paths: Iterable[str],
         if relpath in dirty:
             file_findings = fresh.get(relpath, [])
             shapes_entry = shape_sum.get(relpath, {})
+            locks_entry = lock_sum.get(relpath, {})
         else:
             file_findings = [_finding_from_row(relpath, row)
                              for row in old_entry.get("findings", [])]
             shapes_entry = old_entry.get("shapes",
                                          shape_sum.get(relpath, {}))
+            locks_entry = old_entry.get("locks",
+                                        lock_sum.get(relpath, {}))
         findings.extend(file_findings)
         new_cache[relpath] = {
             "hash": hashes[relpath],
@@ -299,6 +314,7 @@ def run_project(paths: Iterable[str],
                              file_findings,
                              key=lambda f: (f.line, f.code, f.message))],
             "shapes": shapes_entry,
+            "locks": locks_entry,
         }
 
     if cache_path is not None and not errors:

@@ -823,5 +823,13 @@ class TracerTaintRule:
 
 
 def default_project_rules() -> list:
+    from volsync_tpu.analysis.guards import (
+        CheckThenActRule,
+        GuardedFieldRule,
+        UnsyncPublicationRule,
+    )
+    from volsync_tpu.analysis.lockflow import LockOrderRule
+
     return [LockRegionRule(), ThreadLifecycleRule(), ResourceLeakRule(),
-            TracerTaintRule()]
+            TracerTaintRule(), LockOrderRule(), GuardedFieldRule(),
+            CheckThenActRule(), UnsyncPublicationRule()]

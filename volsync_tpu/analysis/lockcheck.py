@@ -280,3 +280,13 @@ def order_graph() -> dict[str, set[str]]:
     """Copy of the observed lock-order edges (name -> successors)."""
     with _state:
         return {k: set(v) for k, v in _edges.items()}
+
+
+def graph() -> set:
+    """The runtime-observed acquisition edges as a flat ``(held,
+    acquired)`` name-pair set — the shape the static cross-check
+    compares against (every edge here must be covered by the VL401
+    graph, wildcard lock names matching by prefix; see
+    analysis/lockflow.py)."""
+    with _state:
+        return {(a, b) for a, succs in _edges.items() for b in succs}

@@ -65,7 +65,9 @@ class EntrypointCatalog:
     """Global registry of data-plane entrypoints, name -> callable(ctx)->int."""
 
     def __init__(self):
-        self._entries: dict[str, Callable] = {}
+        # entrypoints register at import/setup time, before any
+        # JobRunner worker thread starts; threads only read
+        self._entries: dict[str, Callable] = {}  # lint: ignore[VL404]
 
     def register(self, name: str, fn: Optional[Callable] = None):
         if fn is None:

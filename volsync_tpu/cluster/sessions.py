@@ -239,7 +239,7 @@ class SessionSupervisor:
     # -- state bookkeeping --------------------------------------------------
 
     def _transition(self, to: str, cause: str) -> None:
-        # caller holds self._lock
+        lockcheck.assert_held(self._lock, "session state transition")
         if to == self.state:
             return
         self.state = to

@@ -376,7 +376,10 @@ class RestoreGroup:
 
     def __init__(self, *, budget_bytes: Optional[int] = None):
         self._budget = budget_bytes
-        self._caches: dict[int, PackCache] = {}
+        # safe unlocked: run() pre-populates per-store caches before
+        # any thread starts; job threads only read (Thread.start() is
+        # the happens-before edge)
+        self._caches: dict[int, PackCache] = {}  # lint: ignore[VL404]
         self._jobs: list[tuple] = []
 
     def cache_for(self, store) -> PackCache:

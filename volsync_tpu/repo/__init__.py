@@ -1,0 +1,12 @@
+"""Repository layer: content-addressed blob store with packfiles,
+sharded compact index, encryption envelope, integrity scrub, and the
+multi-writer fencing protocol.
+
+This ``__init__`` also matters to tooling: without it the directory is
+a PEP 420 namespace dir, ``analysis/callgraph.py``'s module naming
+degrades to bare stems ('repository' instead of
+'volsync_tpu.repo.repository'), and every cross-module call into the
+repo layer becomes unresolvable — which silently blinded the
+interprocedural lint rules (VL101, VL4xx) to exactly the code with
+the most lock traffic.
+"""

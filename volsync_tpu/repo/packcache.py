@@ -96,7 +96,9 @@ class PackCache:
         Concurrent inserts can only turn bits on, so a racy read can
         produce a false negative ONLY for a pack whose insert is still
         mid-flight — and that pack's flight is found under the lock."""
-        return bool(self._filter.maybe_contains_rows(
+        # deliberate benign race (see docstring): bits are monotonic,
+        # a stale read only costs a lock-path probe
+        return bool(self._filter.maybe_contains_rows(  # lint: ignore[VL402]
             as_key_rows([pack_id]))[0])
 
     # -- fetch -------------------------------------------------------------

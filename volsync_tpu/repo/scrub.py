@@ -142,7 +142,9 @@ class ScrubService:
         self.corruptions = 0
         self.healed = 0
         self.unhealable = 0
-        self.outcomes: dict[str, int] = {}
+        # single-writer: only the cycle thread (or a test calling
+        # run_once synchronously) mutates; readers join() via stop()
+        self.outcomes: dict[str, int] = {}  # lint: ignore[VL404]
         self.last_report: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -71,7 +71,9 @@ class ContinuousGC:
         self.lock_wait = lock_wait
         self._repo = None
         self.cycles = 0
-        self.outcomes: dict[str, int] = {}
+        # single-writer: only the cycle thread (or a test calling
+        # run_once synchronously) mutates; readers join() via stop()
+        self.outcomes: dict[str, int] = {}  # lint: ignore[VL404]
         self.last_report: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -280,9 +280,9 @@ class SegmentScheduler:
         if not actives:
             return False
         for name in actives:
-            st = self._states[name]
             ready: list[_Item] = []
             with self._lock:
+                st = self._states[name]
                 if not st.q:
                     st.deficit = 0.0
                     continue
@@ -312,11 +312,15 @@ class SegmentScheduler:
         return True
 
     def _deadline_counter(self, tenant: str):
-        c = self._deadline_c.get(tenant)
-        if c is None:
-            c = self._deadline_c[tenant] = \
-                GLOBAL_METRICS.svc_deadline_exceeded.labels(tenant=tenant)
-        return c
+        # cold path (deadline sheds only); the lock keeps the cache
+        # honest even though today only the scheduler thread calls it
+        with self._lock:
+            c = self._deadline_c.get(tenant)
+            if c is None:
+                c = self._deadline_c[tenant] = \
+                    GLOBAL_METRICS.svc_deadline_exceeded.labels(
+                        tenant=tenant)
+            return c
 
     def _dispatch(self, st: _TenantState, item: _Item) -> None:
         # deadline shed BEFORE the slot acquire: an expired segment must
@@ -407,7 +411,9 @@ class SegmentScheduler:
                     self._queued -= 1
                 st.deficit = 0.0
         for item in stranded:
-            st = self._states[item.tenant]
+            # unlocked read is safe here: the scheduler thread has
+            # been joined above, teardown is single-threaded
+            st = self._states[item.tenant]  # lint: ignore[VL402]
             st.credits.release()
             st.depth_gauge.set(0)
             if item.qspan is not None:

@@ -91,6 +91,29 @@ DEADLINE_CLASS_METADATA_KEY = "x-volsync-deadline-class"
 DEFAULT_SEGMENT_SIZE = 32 * 1024 * 1024
 
 
+def _timed_ingest(request_iterator, ctx):
+    """Yield request frames, timing each blocking pull as a
+    ``svc.ingest`` span: that wait is paced by the CLIENT (its
+    chunking, transport, OS scheduling) yet elapses inside the
+    enclosing ``svc.stream`` span, so without it the per-tenant stage
+    breakdown has a hole exactly as wide as the client is slow. No
+    span is left open across the ``yield`` — abandoning the stream
+    mid-iteration leaks nothing."""
+    it = iter(request_iterator)
+    while True:
+        h = begin_span("svc.ingest", ctx=ctx)
+        try:
+            seg = next(it)
+        except StopIteration:
+            h.finish("ok")
+            return
+        except BaseException:
+            h.finish("error")
+            raise
+        h.finish("ok")
+        yield seg
+
+
 class _TokenInterceptor(grpc.ServerInterceptor):
     """Constant-time bearer-token check, tenant-scoped: a tenant with
     its own token must present it; everyone else presents the service
@@ -331,7 +354,22 @@ class MoverJaxServer:
         if cls is not None:
             ticket.deadline = self.deadline_classes.get(str(cls))
         try:
-            yield from self._serve_stream(request_iterator, ticket)
+            # Client-paced waits (pulling request frames, the consumer
+            # draining a yielded batch) happen INSIDE the svc.stream
+            # span but outside every server component span; timing
+            # them as svc.ingest/svc.emit makes the per-tenant stage
+            # breakdown account for the stream span even when the
+            # client thread is starved for CPU.
+            inner = self._serve_stream(
+                _timed_ingest(request_iterator, stream_ctx), ticket)
+            for batch in inner:
+                emit = begin_span("svc.emit", ctx=stream_ctx)
+                try:
+                    yield batch
+                except BaseException:
+                    emit.finish("error")
+                    raise
+                emit.finish("ok")
         except DeadlineExceeded as exc:
             handle.finish("error")
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
