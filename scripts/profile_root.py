@@ -37,7 +37,7 @@ def timeit(name, fn, *args):
     t0 = time.perf_counter()
     out = None
     for i in range(ITERS):
-        out = fn(*args, jnp.uint8(i + 1))
+        out = fn(*args, jnp.uint8(i + 1))  # lint: ignore[VL502] per-dispatch timing is the measurement
     float(out)
     dt = (time.perf_counter() - t0) / ITERS
     print(f"{name:30s} {dt * 1e3:8.2f} ms  {N / dt / (1 << 30):7.2f} GiB/s",

@@ -8,14 +8,19 @@
 #      byte copies outside the sanctioned copy-ledger sites) and VL301
 #      (span names must be literal dotted lowercase), the interprocedural
 #      VL101-VL104 family, the VL201-VL205
-#      shape/dtype abstract interpreter, and the VL401-VL404 static
+#      shape/dtype abstract interpreter, the VL401-VL404 static
 #      concurrency family (lock-order cycle proofs, guarded-field race
-#      inference, check-then-act, unsynchronized publication)
+#      inference, check-then-act, unsynchronized publication), and the
+#      VL501-VL505 buffer-provenance family (implicit device->host
+#      syncs, per-item dispatch loops, unledgered pooled copies,
+#      use-after-donate, copy-ledger sanction drift)
 #      (tests/test_analysis.py enforces the same in tier-1). Emits a
 #      SARIF 2.1.0 report to lint.sarif for CI upload and uses the
 #      content-hash incremental cache (.lint-cache): an immediate
-#      second run ASSERTS the warm cache re-analyzes zero files, so
-#      the cached lock/shape summary plumbing can't silently regress.
+#      second run ASSERTS the warm cache re-analyzes zero files AND
+#      that the cache rows carry the "buf" provenance fact kind, so
+#      the cached lock/shape/provenance summary plumbing can't
+#      silently regress.
 #   2. The pipeline + crash-recovery suites with the lock-order/race
 #      detector armed at process start (VOLSYNC_TPU_LOCKCHECK=1), so
 #      module-level locks are instrumented too.
@@ -95,6 +100,13 @@ echo "$warm" | grep -q "cache: analyzed 0 of" || {
     echo "$warm" >&2
     exit 1
 }
+python - <<'EOF'
+import json, sys
+rows = json.load(open(".lint-cache"))["files"]
+if not any(row.get("buf") for row in rows.values()):
+    sys.exit('lint cache rows carry no "buf" provenance facts — the '
+             'VL5xx summary cache plumbing regressed')
+EOF
 
 echo "== lockcheck-armed pipeline suites =="
 JAX_PLATFORMS=cpu VOLSYNC_TPU_LOCKCHECK=1 \

@@ -1,0 +1,5 @@
+"""VL5xx buffer-provenance fixtures: each module seeds one rule's
+true positive next to a clean twin (pooled-copy hop chains through
+helper calls, per-item dispatch loops vs trace-time unrolls, jit-twin
+donation flows, ledger drift). Deliberately violating; linted by
+tests, never imported."""

@@ -1,0 +1,11 @@
+"""Fixture buffer pool: the VL503 provenance source. The analyzer
+matches the ``bufpool.GLOBAL.acquire(n)`` call shape syntactically;
+this module just makes the fixture tree import-coherent."""
+
+
+class _Pool:
+    def acquire(self, n):
+        return bytearray(n)
+
+
+GLOBAL = _Pool()

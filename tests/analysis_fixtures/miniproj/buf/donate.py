@@ -1,0 +1,52 @@
+"""VL504 fixture: reads after donation — directly after calling a
+``donate_argnums`` jit twin, and through a helper whose conditional
+twin binding (``twin_donated if fused else twin``) makes it
+maybe-donating — next to the clean twins (non-donating twin, a fresh
+temporary donated, a rebind before the next read). Parsed only, never
+imported."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _impl(x):
+    return x * 2
+
+
+twin = jax.jit(_impl)
+twin_donated = functools.partial(jax.jit, donate_argnums=(0,))(_impl)
+
+
+def use_after_donate(rows):
+    dev = jnp.asarray(rows)
+    out = twin_donated(dev)  # MARK: donate-site
+    return out, dev.sum()  # MARK: donate-read
+
+
+def helper_hash(dev, fused):
+    fn = twin_donated if fused else twin  # maybe-donating binding
+    return fn(dev)  # MARK: helper-donate-site
+
+
+def use_after_helper_donate(rows):
+    dev = jnp.asarray(rows)
+    out = helper_hash(dev, True)
+    return out, dev.mean()  # MARK: helper-donate-read
+
+
+def nondonating_use(rows):
+    dev = jnp.asarray(rows)
+    out = twin(dev)  # twin donates nothing — clean
+    return out, dev.sum()
+
+
+def fresh_temp(rows):
+    return twin_donated(jnp.asarray(rows))  # nothing read back — clean
+
+
+def rebound(rows):
+    dev = jnp.asarray(rows)
+    out = twin_donated(dev)
+    dev = jnp.asarray(out)  # rebound before any read — clean
+    return dev.sum()

@@ -1,0 +1,38 @@
+"""VL501 fixture: implicit device->host syncs in a hot scope (this
+file lives under an ``engine/`` directory) next to the two sanctioned
+shapes — an explicit staging site that ledgers a record_copy, and a
+reviewed same-line suppression. Parsed only, never imported."""
+import jax.numpy as jnp
+import numpy as np
+
+from miniproj.obs.copyledger import record_copy
+
+
+def leak_float(dev):
+    acc = jnp.square(dev)
+    return float(acc)  # MARK: sync-float
+
+
+def leak_item(dev):
+    total = jnp.sum(dev)
+    return total.item()  # MARK: sync-item
+
+
+def leak_asarray(dev):
+    rows = jnp.reshape(dev, (-1, 32))
+    return np.asarray(rows)  # MARK: sync-asarray
+
+
+def staged_fetch(dev):
+    """Clean twin: the function IS the explicit staging site — it
+    ledgers a sanctioned record_copy, so its batched fetch is the
+    sanctioned kind of sync."""
+    rows = jnp.reshape(dev, (-1, 32))
+    out = np.asarray(rows)  # MARK: staged-clean
+    record_copy("fix.stage", out.nbytes)
+    return out
+
+
+def reviewed_fetch(dev):
+    ticks = jnp.cumsum(dev)
+    return float(ticks)  # lint: ignore[VL501] fixture: reviewed one-off sync

@@ -1,0 +1,13 @@
+"""VL503 two-hop helpers: ``finish`` materializes its parameter; on
+its own that is silent (unknown provenance) — the finding only fires
+because ``pool.ship`` feeds a memoryview of a pooled buffer through
+``relay`` into it, and the interprocedural fixpoint carries the hop
+chain across both calls. Parsed only, never imported."""
+
+
+def finish(part):
+    return part.tobytes()  # MARK: twohop-mat
+
+
+def relay(chunk):
+    return finish(chunk)  # MARK: twohop-relay

@@ -1,0 +1,25 @@
+"""VL505 fixture: ledger<->sanction drift — a record_copy call whose
+site is missing from the fixture SANCTIONED_SITES, and one whose site
+name is not a string literal — next to the clean shape (a ledgered
+copy at a sanctioned site). The third drift direction, a sanctioned
+site with no call site, lives in the fixture ledger itself
+(``fix.unused``). Parsed only, never imported."""
+from miniproj.obs.copyledger import record_copy
+
+_SITE = "fix.dynamic"
+
+
+def ingest(data):
+    out = bytes(data)
+    record_copy("fix.ingest", len(out))  # sanctioned — clean
+    return out
+
+
+def rogue(data):
+    record_copy("fix.rogue", len(data))  # MARK: rogue-site
+    return data
+
+
+def dynamic(data):
+    record_copy(_SITE, len(data))  # MARK: nonliteral-site
+    return data

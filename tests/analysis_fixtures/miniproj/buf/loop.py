@@ -1,0 +1,37 @@
+"""VL502 fixture: device dispatch inside per-item Python loops (for
+loop and comprehension) next to the three clean shapes — one batched
+dispatch, a constant-literal structural unroll, and a loop inside a
+``jax.lax`` combinator closure (trace time, unrolls into one compiled
+program). Parsed only, never imported."""
+import jax
+import jax.numpy as jnp
+
+
+def per_item(chunks):
+    out = []
+    for c in chunks:
+        out.append(jnp.asarray(c))  # MARK: loop-dispatch
+    return out
+
+
+def per_item_comp(chunks):
+    return [jnp.square(c) for c in chunks]  # MARK: comp-dispatch
+
+
+def batched(chunks):
+    return jnp.asarray(chunks)  # MARK: batched-clean
+
+
+def log_depth(x):
+    for m in (1, 2, 4, 8, 16):
+        x = x + jnp.roll(x, m)  # constant unroll — clean
+    return x
+
+
+def scanned(xs, offsets):
+    def step(carry, x):
+        for off in offsets:
+            carry = carry + jnp.add(x, off)  # lax.scan closure — clean
+        return carry, x
+
+    return jax.lax.scan(step, jnp.uint8(0), xs)

@@ -1,0 +1,31 @@
+"""VL503 fixture: semantic copies of pooled-buffer provenance — a
+direct ``bytes()`` of an acquired buffer, and a two-hop
+interprocedural case where a memoryview of the pooled buffer crosses
+two helper calls before being materialized — next to the clean twins
+(a ledgered copy and a view that stays a view). Parsed only, never
+imported."""
+from miniproj.buf import bufpool
+from miniproj.buf.helpers import relay
+from miniproj.obs.copyledger import record_copy
+
+
+def leak_bytes(n):
+    buf = bufpool.GLOBAL.acquire(n)  # MARK: copy-acquire
+    return bytes(buf)  # MARK: copy-bytes
+
+
+def ledgered(n):
+    buf = bufpool.GLOBAL.acquire(n)
+    out = bytes(buf)  # MARK: copy-ledgered
+    record_copy("fix.ingest", len(out))
+    return out
+
+
+def window(n):
+    buf = bufpool.GLOBAL.acquire(n)
+    return memoryview(buf)[: n // 2]  # view stays a view — clean
+
+
+def ship(n):
+    buf = bufpool.GLOBAL.acquire(n)  # MARK: twohop-acquire
+    return relay(memoryview(buf))  # MARK: twohop-entry

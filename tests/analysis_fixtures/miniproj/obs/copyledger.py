@@ -1,0 +1,17 @@
+"""Fixture copy ledger: when the linted tree carries an
+``obs/copyledger.py``, the VL5xx analyzer resolves SANCTIONED_SITES
+from THIS file's AST (not the installed package's), so the miniproj
+fixtures exercise ledger resolution end to end. ``fix.unused`` is the
+VL505 dead-entry true positive: no fixture module ever records it.
+Parsed only, never imported."""
+
+SANCTIONED_SITES = frozenset({
+    "fix.ingest",   # pool.py ledgered() / ledger_use.py ingest()
+    "fix.stage",    # buf/engine/hot.py staged_fetch() staging site
+    "fix.unused",   # MARK: unused-site
+})
+
+
+def record_copy(site, nbytes):
+    """Fixture stand-in — the analyzer only matches the call shape."""
+    del site, nbytes

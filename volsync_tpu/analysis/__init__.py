@@ -17,7 +17,14 @@ Two halves:
   shapes, implicit dtype promotion out of uint32 hash arithmetic,
   ``lax.scan`` carry drift, ``vmap`` axis arity, and mesh axis names
   vs ``parallel/mesh.py`` (VL201-VL205), with interprocedural shape
-  summaries. SARIF/JSON output (full source spans) and a content-hash
+  summaries; plus a static concurrency analyzer over the lock regions
+  (``lockflow``): lock-order cycles, guarded-field races,
+  check-then-act windows, unsynchronized publication (VL401-VL404);
+  plus a buffer-provenance and device-boundary analyzer (``bufflow``):
+  implicit device->host syncs, per-item dispatch loops, unledgered
+  pooled-buffer copies, use-after-donate, copy-ledger sanction drift
+  (VL501-VL505) — the zero-copy data plane's laws, proven statically.
+  SARIF/JSON output (full source spans) and a content-hash
   incremental cache live in ``sarif``/``cache``; ``--select`` /
   ``--ignore`` stage rule families by code prefix.
 

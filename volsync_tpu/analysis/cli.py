@@ -29,7 +29,8 @@ def build_parser() -> argparse.ArgumentParser:
                     "(per-file rules VL001-VL005, VL105 and VL301, "
                     "interprocedural rules VL101-VL104, shape/dtype "
                     "rules VL201-VL205, static concurrency rules "
-                    "VL401-VL404; see docs/development.md)")
+                    "VL401-VL404, buffer-provenance rules "
+                    "VL501-VL505; see docs/development.md)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to lint (default: the installed "
@@ -71,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write the static lock-acquisition-order graph "
              "(VL401's evidence: nodes=lock names, edges with hop "
              "chains) to FILE as JSON, '-' for stdout")
+    parser.add_argument(
+        "--dump-provenance", default=None, metavar="FILE",
+        help="also write the buffer-provenance graph (VL5xx "
+             "evidence: sanctioned sites, per-function provenance "
+             "nodes, interprocedural hop edges) to FILE as JSON, "
+             "'-' for stdout")
     return parser
 
 
@@ -139,6 +146,21 @@ def main(argv: Optional[list] = None, out=print) -> int:
                                                   encoding="utf-8")
             out(f"wrote lock graph to {args.dump_lock_graph} "
                 f"({len(graph['edges'])} edge(s))")
+
+    if args.dump_provenance:
+        from volsync_tpu.analysis.bufflow import (
+            dump_for_paths as dump_provenance,
+        )
+
+        prov = dump_provenance(paths)
+        text = json.dumps(prov, indent=2, sort_keys=True)
+        if args.dump_provenance == "-":
+            out(text)
+        else:
+            Path(args.dump_provenance).write_text(text + "\n",
+                                                  encoding="utf-8")
+            out(f"wrote provenance graph to {args.dump_provenance} "
+                f"({len(prov['edges'])} edge(s))")
 
     baseline_path = Path(args.baseline) if args.baseline else Path(
         DEFAULT_BASELINE)

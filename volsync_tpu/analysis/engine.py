@@ -129,6 +129,18 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
             yield p
 
 
+def relativize(path: Path) -> str:
+    """Cwd-relative posix path when ``path`` lives under the cwd, else
+    the path as-is.  The single relativization policy for cache keys,
+    scope decisions and dump/SARIF artifacts: an absolute
+    ``/root/repo/bench.py`` must not inherit a ``repo`` scope dir, and
+    dump files must not leak absolute checkout paths."""
+    try:
+        return path.relative_to(Path.cwd()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
 def _suppressed(ctx: FileContext, finding: Finding) -> bool:
     m = _SUPPRESS_RE.search(ctx.line_text(finding.line))
     if not m:
@@ -196,13 +208,7 @@ def run_project(paths: Iterable[str],
     blobs: list[tuple[Path, str, bytes]] = []  # (path, relpath, bytes)
     seen: set[str] = set()
     for path in iter_py_files(paths):
-        # anchor at the cwd when possible: scope decisions and cache
-        # keys must not depend on where the checkout lives (an absolute
-        # /root/repo/bench.py must not inherit a 'repo' scope dir)
-        try:
-            relpath = path.relative_to(Path.cwd()).as_posix()
-        except ValueError:
-            relpath = path.as_posix()
+        relpath = relativize(path)
         if relpath in seen:
             continue
         seen.add(relpath)
@@ -289,6 +295,18 @@ def run_project(paths: Iterable[str],
 
         lock_sum = lock_summaries(index)
 
+    # buffer-provenance facts (per-function return provenance, donated
+    # params, sanctioned/record sites) are the VL5xx analogue: cached
+    # per file so a warm run skips the provenance pass entirely
+    buf_sum: dict = {}
+    if any(str(getattr(r, "code", "")).startswith("VL5")
+           for r in project_rules):
+        from volsync_tpu.analysis.bufflow import (
+            summaries_for as buf_summaries,
+        )
+
+        buf_sum = buf_summaries(index)
+
     findings: list[Finding] = []
     new_cache: dict[str, dict] = {}
     for relpath in sorted(parsed):
@@ -297,6 +315,7 @@ def run_project(paths: Iterable[str],
             file_findings = fresh.get(relpath, [])
             shapes_entry = shape_sum.get(relpath, {})
             locks_entry = lock_sum.get(relpath, {})
+            buf_entry = buf_sum.get(relpath, {})
         else:
             file_findings = [_finding_from_row(relpath, row)
                              for row in old_entry.get("findings", [])]
@@ -304,6 +323,7 @@ def run_project(paths: Iterable[str],
                                          shape_sum.get(relpath, {}))
             locks_entry = old_entry.get("locks",
                                         lock_sum.get(relpath, {}))
+            buf_entry = old_entry.get("buf", buf_sum.get(relpath, {}))
         findings.extend(file_findings)
         new_cache[relpath] = {
             "hash": hashes[relpath],
@@ -315,6 +335,7 @@ def run_project(paths: Iterable[str],
                              key=lambda f: (f.line, f.code, f.message))],
             "shapes": shapes_entry,
             "locks": locks_entry,
+            "buf": buf_entry,
         }
 
     if cache_path is not None and not errors:

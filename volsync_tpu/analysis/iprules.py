@@ -828,8 +828,10 @@ def default_project_rules() -> list:
         GuardedFieldRule,
         UnsyncPublicationRule,
     )
+    from volsync_tpu.analysis.bufflow import default_buf_rules
     from volsync_tpu.analysis.lockflow import LockOrderRule
 
     return [LockRegionRule(), ThreadLifecycleRule(), ResourceLeakRule(),
             TracerTaintRule(), LockOrderRule(), GuardedFieldRule(),
-            CheckThenActRule(), UnsyncPublicationRule()]
+            CheckThenActRule(), UnsyncPublicationRule(),
+            *default_buf_rules()]

@@ -760,17 +760,16 @@ def graph_json(index: ProjectIndex) -> dict:
 def dump_for_paths(paths) -> dict:
     """Build the acquisition graph for a path set from scratch —
     the ``volsync lint --dump-lock-graph`` entry point."""
-    from pathlib import Path
-
     from volsync_tpu.analysis.callgraph import build_index
-    from volsync_tpu.analysis.engine import FileContext, iter_py_files
+    from volsync_tpu.analysis.engine import (
+        FileContext,
+        iter_py_files,
+        relativize,
+    )
 
     contexts = []
     for path in iter_py_files(paths):
-        try:
-            relpath = path.relative_to(Path.cwd()).as_posix()
-        except ValueError:
-            relpath = path.as_posix()
+        relpath = relativize(path)
         try:
             source = path.read_bytes().decode("utf-8")
             tree = ast.parse(source, filename=str(path))

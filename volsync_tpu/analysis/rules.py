@@ -446,8 +446,16 @@ class HotPathCopyRule:
         parts = ctx.scope_dirs()
         if not any(p in parts for p in self.SCOPE_PARTS):
             return
+        # consult the VL503 sanction verdict: a copy whose statement
+        # (or adjacent sibling) ledgers a sanctioned record_copy is the
+        # accounted-for kind — no blanket suppression needed
+        from volsync_tpu.analysis.bufflow import sanctioned_lines
+
+        ledgered = sanctioned_lines(ctx.tree)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
+                continue
+            if node.lineno in ledgered:
                 continue
             f = node.func
             if isinstance(f, ast.Attribute) and f.attr == "tobytes":

@@ -274,7 +274,7 @@ def device_leaf_digests(dev, leaf_starts: list[int],
     lengths = np.zeros((lanes,), np.int32)
     starts[: len(leaf_starts)] = leaf_starts
     lengths[: len(leaf_lengths)] = leaf_lengths
-    digests = np.asarray(sha256_chunks_device(
+    digests = np.asarray(sha256_chunks_device(  # lint: ignore[VL501] one batched 32 B/lane digest download — this helper's contract
         dev, jnp.asarray(starts), jnp.asarray(lengths),
         max_len=blobid.LEAF_SIZE,
     )).astype(">u4")
@@ -333,7 +333,7 @@ def _dispatch_leaves(dev, full_rows, short_starts, short_lengths,
 
 def _assemble_roots(chunks, plan, digests_np, lanes_f) -> list[str]:
     full_rows, short_starts, _, slot, spans = plan
-    flat = digests_np.astype(">u4").tobytes()  # lint: ignore[VL106] digests
+    flat = digests_np.astype(">u4").tobytes()  # lint: ignore[VL106] 32 B/leaf digest wire form, metadata not payload
 
     def leaf(is_full: bool, i: int) -> bytes:
         base = (i if is_full else lanes_f + i) * 32
@@ -517,12 +517,12 @@ def hash_spans(buffer, spans: list[tuple[int, int]]) -> list[str]:
         # their id is a constant anyway.
         empty = lengths[: len(spans)] == 0
         lengths[: len(spans)][empty] = -1
-        roots = np.asarray(span_roots_device(
+        roots = np.asarray(span_roots_device(  # lint: ignore[VL501] one batched 32 B/span root download — metadata, not payload
             _upload_padded(buffer), jnp.asarray(starts),
             jnp.asarray(lengths))).astype(">u4")
         empty_id = blobid.blob_id(b"")
         return [empty_id if empty[i]
-                else roots[i].tobytes().hex()  # lint: ignore[VL106] digests
+                else roots[i].tobytes().hex()  # lint: ignore[VL106] 32 B span-root ids, metadata not payload
                 for i in range(len(spans))]
     return device_span_roots(_upload_padded(buffer), spans)
 
@@ -623,7 +623,7 @@ def hash_file_streaming(path, *, segment_size: int = 32 * 1024 * 1024) -> str:
                     dev = _upload_padded(arr[: full * blobid.LEAF_SIZE])
                     dig = page_digests(dev)[:full].astype(">u4")
                     leaves.extend(
-                        dig[k].tobytes()  # lint: ignore[VL106] digests
+                        dig[k].tobytes()  # lint: ignore[VL106] 32 B leaf digest rows, metadata not payload
                         for k in range(full))
                 if n % blobid.LEAF_SIZE:
                     leaves.append(hashlib.sha256(

@@ -305,13 +305,16 @@ def delta_scan_batch(items) -> list[list[Op]]:
             sorted_weak[r, : len(w)] = np.sort(w, kind="stable")
         nscan = np.array([ln - block_len + 1 for ln in lens], np.int32)
         width = L - block_len + 1
-        dev = jnp.asarray(data)
-        sw_dev = jnp.asarray(sorted_weak)
-        nb_dev = jnp.asarray(nb)
-        ns_dev = jnp.asarray(nscan)
+        # The loop variable here is a block_len BUCKET, not a file: each
+        # iteration uploads and matches one whole padded [n, L] batch —
+        # this IS the batched path (one dispatch per distinct block_len).
+        dev = jnp.asarray(data)  # lint: ignore[VL502] per-bucket batch upload
+        sw_dev = jnp.asarray(sorted_weak)  # lint: ignore[VL502] per-bucket batch upload
+        nb_dev = jnp.asarray(nb)  # lint: ignore[VL502] per-bucket batch upload
+        ns_dev = jnp.asarray(nscan)  # lint: ignore[VL502] per-bucket batch upload
         cap = max(1024, _pow2ceil(sum(ln // block_len for ln in lens) * 4))
         while True:
-            cand_dev, count = match_offsets_batch(
+            cand_dev, count = match_offsets_batch(  # lint: ignore[VL502] one dispatch per bucket batch
                 dev, sw_dev, nb_dev, ns_dev, window=block_len,
                 max_candidates=cap)
             total = int(count)

@@ -881,7 +881,7 @@ class Repository:
         needs this; pack upload and decode stream the parts."""
         if len(seg) == 1:
             return seg[0]
-        out = b"".join(seg)  # lint: ignore[VL106] ledgered copy
+        out = b"".join(seg)
         record_copy("repo.buffered_read", len(out))
         return out
 
