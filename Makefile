@@ -2,7 +2,7 @@
 # scripts/static_check.sh (lint + lockcheck-armed suites) and the
 # tier-1 command in ROADMAP.md.
 
-.PHONY: lint lint-locks lint-buf test chaos chaos-concurrent chaos-fleet \
+.PHONY: lint lint-locks lint-buf lint-fx test chaos chaos-concurrent chaos-fleet \
 	chaos-restore chaos-scrub scrub-smoke static-check \
 	bench-index-smoke service-bench-smoke fleet-bench-smoke \
 	restore-bench-smoke copies-smoke syncplan-bench-smoke \
@@ -11,8 +11,9 @@
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105/VL106 + VL301 per-file + VL101-VL104
 # interprocedural + VL201-VL205 shape/dtype abstract interpretation +
-# VL401-VL404 static concurrency + VL501-VL505 buffer provenance, no
-# baseline. Warm runs re-analyze zero files; see docs/development.md.
+# VL401-VL404 static concurrency + VL501-VL505 buffer provenance +
+# VL601-VL605 fault paths, no baseline. Warm runs re-analyze zero
+# files; see docs/development.md.
 lint:
 	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
 	    --no-baseline --format sarif --out lint.sarif --cache .lint-cache
@@ -29,6 +30,13 @@ lint-locks:
 lint-buf:
 	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
 	    --no-baseline --select VL5 --dump-provenance provenance.json
+
+# Just the fault-path family (VL601-VL605), with the effect graph
+# (resolved laws, per-function effect/raise summaries, retry-policy
+# edges) exported for inspection.
+lint-fx:
+	python -m volsync_tpu.analysis volsync_tpu/ scripts/ bench.py \
+	    --no-baseline --select VL6 --dump-effects effects.json
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
@@ -162,4 +170,5 @@ session-smoke:
 	JAX_PLATFORMS=cpu python scripts/session_smoke.py
 
 clean-lint:
-	rm -f lint.sarif .lint-cache lock-graph.json provenance.json
+	rm -f lint.sarif .lint-cache lock-graph.json provenance.json \
+	    effects.json

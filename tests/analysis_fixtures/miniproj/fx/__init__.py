@@ -1,0 +1,8 @@
+"""VL6xx fault-path fixtures: each module seeds one rule's true
+positive next to a clean twin (bare store effects vs policy-covered
+paths, a two-hop stacked-retry chain, generic vs typed raises, an
+unfenced publish behind a key helper, a crash-ordering swap), with
+the laws — ``_RETRIED_OPS``, ``SINGLE_ATTEMPT_OPS``, ``classify()``,
+``FENCED_KEY_FAMILIES``, ``CRASH_ORDERINGS`` — declared by the
+fixture tree itself. Deliberately violating; linted by tests, never
+imported."""

@@ -1,0 +1,4 @@
+"""The fx fixtures' data-plane scope dir: modules here sit in the
+analyzer's effect scope ("repo"), so their store ops are summarized
+and the VL601/602/604/605 checks run against them. Parsed only,
+never imported."""

@@ -1,0 +1,13 @@
+"""VL603 fixture: a generic ``raise RuntimeError`` in the data plane
+(the taxonomy's ``classify()`` cannot type it) next to the clean twin
+raising a typed ``FixError`` (a ValueError kin the decision table
+decides). Parsed only, never imported."""
+from miniproj.fx.resilience import FixError
+
+
+def fail_generic(reason):
+    raise RuntimeError("sweep failed: " + reason)  # MARK: vl603-generic
+
+
+def fail_typed(reason):
+    raise FixError("sweep failed: " + reason)  # MARK: vl603-typed

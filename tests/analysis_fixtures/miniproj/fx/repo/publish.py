@@ -1,0 +1,37 @@
+"""VL604 fixture: fenced-family publishes — an ``index/`` put with no
+``_guard_publish`` dominator, and a ``snap/`` put inside a key-taking
+helper reached from an unguarded caller — next to the clean twins (a
+guarded direct publish, and the same helper reached from a caller
+that fences first). Declares the fixture tree's own
+``FENCED_KEY_FAMILIES``. Parsed only, never imported."""
+from miniproj.fx.resilience import FixError, RetryPolicy
+
+FENCED_KEY_FAMILIES = ("index/", "snap/")
+
+
+class Publisher:
+    def __init__(self, store):
+        self.store = store
+        self.policy = RetryPolicy()
+        self.fenced = False
+
+    def _guard_publish(self, what):
+        if self.fenced:
+            raise FixError("fenced writer may not publish " + what)
+
+    def publish_ok(self, payload):
+        self._guard_publish("index head")
+        self.policy.call(self.store.put, "index/head", payload)
+
+    def publish_bad(self, payload):
+        self.policy.call(self.store.put, "index/head", payload)  # MARK: vl604-direct
+
+    def _emit_key(self, key, payload):
+        self.policy.call(self.store.put, key, payload)  # MARK: vl604-helper-effect
+
+    def emit_guarded(self, payload):
+        self._guard_publish("snap head")
+        self._emit_key("snap/head", payload)
+
+    def emit_unguarded(self, payload):
+        self._emit_key("snap/head", payload)  # MARK: vl604-helper-call

@@ -1,0 +1,40 @@
+"""VL602 fixture: retry stacking — a full RetryPolicy over a call
+chain whose store op already runs under the boundary ResilientStore
+layer, two hops away (``sync -> _mid -> _fetch``), and a local double
+(``policy.call(store.get, ...)`` where ``get`` is already retried) —
+next to the clean twin: the proven-wrap flag branch that keeps
+exactly one layer per arm. Parsed only, never imported."""
+from miniproj.fx.resilience import ResilientStore, RetryPolicy
+
+
+class Pusher:
+    def __init__(self, store):
+        self.store = store
+        self._inner = RetryPolicy()
+        self._outer = RetryPolicy()
+        self._store_retries = isinstance(store, ResilientStore)
+
+    def _fetch(self, key):
+        # one layer already: get is in _RETRIED_OPS, the boundary
+        # store is a ResilientStore by the open_store contract
+        return self.store.get(key)
+
+    def _mid(self, key):
+        return self._fetch(key)
+
+    def sync(self, key):
+        return self._outer.call(self._mid, key)  # MARK: vl602-two-hop
+
+    def double_local(self, key):
+        return self._outer.call(self.store.get, key)  # MARK: vl602-local
+
+    def refresh(self, key):
+        # clean twin: branch on the proven-wrap flag — each arm runs
+        # exactly one retry layer
+        def restamp():
+            return self.store.get(key)
+
+        if self._store_retries:
+            return restamp()
+        else:
+            return self._inner.call(restamp)  # MARK: vl602-clean-arm

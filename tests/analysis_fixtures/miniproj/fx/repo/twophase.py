@@ -1,0 +1,42 @@
+"""VL605 fixture: declared two-phase sweep laws — ``sweep_ok``
+executes mark < tomb-scrub < victim-retire in the declared order,
+``sweep_bad`` scrubs the tombstone before marking (a crash between
+them loses the only record of the in-flight sweep). Key families here
+("pending/", "tomb/") are deliberately outside FENCED_KEY_FAMILIES,
+and the puts ride the sanctioned single-attempt op. Parsed only,
+never imported."""
+
+PENDING_PREFIX = "pending/"
+
+#: law -> (function, required call order); proved statically (VL605).
+CRASH_ORDERINGS = {
+    "fx.sweep": ("sweep_ok", (
+        "_mark", "delete-prefix:tomb/", "delete-of:victims",
+    )),
+    "fx.sweep-bad": ("sweep_bad", (
+        "_mark", "delete-prefix:tomb/", "delete-of:victims",
+    )),
+}
+
+
+def tomb_key(sweep_id):
+    return f"tomb/{sweep_id}"
+
+
+def _mark(store, victims):
+    for pack_id in victims:
+        store.put_if_absent(PENDING_PREFIX + pack_id, b"")
+
+
+def sweep_ok(store, victims):
+    _mark(store, victims)
+    store.delete(tomb_key("sweep"))
+    for key in victims:
+        store.delete(key)
+
+
+def sweep_bad(store, victims):
+    store.delete(tomb_key("sweep"))  # MARK: vl605-early-scrub
+    _mark(store, victims)
+    for key in victims:
+        store.delete(key)

@@ -1,0 +1,32 @@
+"""VL601 fixture: network effects with no retry layer — a direct bare
+``store.put`` at a call-graph root, and a two-hop case where the
+effect sits in a helper every caller reaches uncovered — next to the
+clean twins (a policy-wrapped put, and a deliberate single-shot put
+suppressed in-line). ``put`` is outside the fixture ``_RETRIED_OPS``
+table, so a boundary store gives it no implicit layer. Parsed only,
+never imported."""
+from miniproj.fx.resilience import RetryPolicy
+
+
+class Uploader:
+    def __init__(self, store):
+        self.store = store
+        self.policy = RetryPolicy()
+
+    def push_meta(self, payload):
+        self.store.put("meta/head", payload)  # MARK: vl601-direct
+
+    def push_retry(self, payload):
+        # clean twin: the policy carries the one retry layer
+        self.policy.call(self.store.put, "meta/head", payload)
+
+    def push_pinned(self, payload):
+        self.store.put("meta/pin", payload)  # lint: ignore[VL601]
+
+
+def _send_raw(store, key, payload):
+    store.put(key, payload)  # MARK: vl601-hop-effect
+
+
+def mirror_head(store, payload):
+    _send_raw(store, "meta/mirror", payload)  # MARK: vl601-hop-call

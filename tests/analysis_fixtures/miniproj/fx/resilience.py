@@ -1,0 +1,59 @@
+"""Fault-path laws for the fx fixtures, shaped like the real
+``volsync_tpu/resilience.py`` so the analyzer resolves them from the
+linted tree instead of the installed package: a retried-op table, a
+single-attempt sanction set, a ``ResilientStore`` whose hand-written
+methods route through ``policy.call``, and a ``classify()`` decision
+table. Parsed only, never imported."""
+
+_RETRIED_OPS = ("get", "delete")
+
+#: Single-attempt by design: conditional-create is its own protocol
+#: signal, a blind retry would turn "lost the race" into "won it".
+SINGLE_ATTEMPT_OPS = frozenset({"put_if_absent"})
+
+
+class TransientError(Exception):
+    """Retryable weather (the taxonomy's canonical transient kin)."""
+
+
+class FixError(ValueError):
+    """Typed fatal error the taxonomy can decide (ValueError kin)."""
+
+
+class RetryPolicy:
+    def __init__(self, attempts=4, classify_fn=None):
+        self.attempts = attempts
+        self.classify_fn = classify_fn
+
+    def call(self, fn, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+
+class ResilientStore:
+    def __init__(self, inner, policy=None):
+        self.inner = inner
+        self.policy = policy or RetryPolicy()
+
+    def get(self, key):
+        return self.policy.call(self.inner.get, key)
+
+    def delete(self, key):
+        self.policy.call(self.inner.delete, key)
+
+    def put(self, key, data):
+        # single-shot passthrough: put is NOT in _RETRIED_OPS here
+        self.inner.put(key, data)
+
+    def put_if_absent(self, key, data):
+        return self.inner.put_if_absent(key, data)
+
+
+def classify(exc):
+    if isinstance(exc, TransientError):
+        return True
+    if isinstance(exc, (KeyError, ValueError)):
+        return False
+    status = getattr(exc, "status", None)
+    if isinstance(status, int):
+        return 500 <= status < 600
+    return isinstance(exc, OSError)

@@ -350,12 +350,14 @@ def test_vl104_interprocedural_taint_fixture():
     assert "decide(" in direct.message
     # nothing else fires on the fixture package beyond the seeded
     # VL2xx shape/dtype bugs (asserted in test_analysis_shapes.py),
-    # the locks/ concurrency fixtures (test_analysis_locks.py) and the
-    # buf/ buffer-provenance fixtures (test_analysis_buf.py)
+    # the locks/ concurrency fixtures (test_analysis_locks.py), the
+    # buf/ buffer-provenance fixtures (test_analysis_buf.py) and the
+    # fx/ fault-path fixtures (test_analysis_fx.py)
     assert {f.code for f in res.findings} == {
         "VL101", "VL104", "VL201", "VL202", "VL203", "VL204", "VL205",
         "VL401", "VL402", "VL403", "VL404",
-        "VL501", "VL502", "VL503", "VL504", "VL505"}
+        "VL501", "VL502", "VL503", "VL504", "VL505",
+        "VL601", "VL602", "VL603", "VL604", "VL605"}
 
 
 def test_vl101_regions_and_comment_above_suppression(tmp_path):

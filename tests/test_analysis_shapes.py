@@ -191,7 +191,7 @@ def test_cli_select_and_ignore(tmp_path):
     assert codes == {"VL201", "VL202", "VL203", "VL204", "VL205"}
 
     rc = lint_main([str(FIXTURES / "miniproj"), "--no-baseline",
-                    "--ignore", "VL2,VL101,VL104,VL4,VL5",
+                    "--ignore", "VL2,VL101,VL104,VL4,VL5,VL6",
                     "--format", "json",
                     "--out", str(out_file)], out=lambda *_: None)
     assert rc == 0

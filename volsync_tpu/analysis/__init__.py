@@ -23,7 +23,13 @@ Two halves:
   plus a buffer-provenance and device-boundary analyzer (``bufflow``):
   implicit device->host syncs, per-item dispatch loops, unledgered
   pooled-buffer copies, use-after-donate, copy-ledger sanction drift
-  (VL501-VL505) — the zero-copy data plane's laws, proven statically.
+  (VL501-VL505) — the zero-copy data plane's laws, proven statically;
+  plus a fault-path analyzer (``faultflow``): unprotected network
+  effects, retry stacking over ``ResilientStore``, exception-taxonomy
+  drift against ``classify()``, fence-before-publish dominance, and
+  declared crash-ordering laws (VL601-VL605) — the retry/fencing/
+  crash-ordering contracts of ``resilience.py`` and the repository
+  two-phase protocols, proven statically.
   SARIF/JSON output (full source spans) and a content-hash
   incremental cache live in ``sarif``/``cache``; ``--select`` /
   ``--ignore`` stage rule families by code prefix.

@@ -27,7 +27,7 @@ from typing import Optional
 CACHE_VERSION = 1
 # bump when rule logic changes in a way that should bust caches even
 # though rule codes stayed the same
-ANALYZER_REVISION = 4  # 4: VL5xx buffer-provenance family + "buf" facts
+ANALYZER_REVISION = 5  # 5: VL6xx fault-path family + "fx" facts
 
 
 def content_hash(data: bytes) -> str:

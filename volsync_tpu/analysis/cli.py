@@ -30,7 +30,8 @@ def build_parser() -> argparse.ArgumentParser:
                     "interprocedural rules VL101-VL104, shape/dtype "
                     "rules VL201-VL205, static concurrency rules "
                     "VL401-VL404, buffer-provenance rules "
-                    "VL501-VL505; see docs/development.md)")
+                    "VL501-VL505, fault-path rules VL601-VL605; "
+                    "see docs/development.md)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to lint (default: the installed "
@@ -78,6 +79,17 @@ def build_parser() -> argparse.ArgumentParser:
              "evidence: sanctioned sites, per-function provenance "
              "nodes, interprocedural hop edges) to FILE as JSON, "
              "'-' for stdout")
+    parser.add_argument(
+        "--dump-effects", default=None, metavar="FILE",
+        help="also write the fault-path effect graph (VL6xx "
+             "evidence: resolved laws, per-function effect/raise "
+             "summaries, retry-policy call edges) to FILE as JSON, "
+             "'-' for stdout")
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="print per-rule-family finding and suppression-pragma "
+             "counts as JSON instead of findings (CI asserts the "
+             "committed suppression budget against this)")
     return parser
 
 
@@ -110,6 +122,50 @@ def filter_rules(rules: list, select: Optional[list],
             continue
         out.append(rule)
     return out
+
+
+def _family(code: str) -> str:
+    """'VL601' -> 'VL6xx': the rule-family key used by --stats."""
+    return code[:3] + "xx" if len(code) >= 3 else code
+
+
+def lint_stats(paths: list, new: list, errors: list) -> dict:
+    """Per-family counts of (post-baseline) findings and of
+    ``# lint: ignore`` suppression pragmas across the linted files.
+    The suppression counts are what static_check.sh asserts the
+    committed budget against — a pragma with explicit codes is billed
+    to each code's family, a bare ``# lint: ignore`` under "any"."""
+    from volsync_tpu.analysis.engine import _SUPPRESS_RE, iter_py_files
+
+    findings_by: dict = {}
+    for f in new:
+        fam = _family(f.code)
+        findings_by[fam] = findings_by.get(fam, 0) + 1
+    supp_by: dict = {}
+    n_supp = 0
+    for path in iter_py_files(paths):
+        try:
+            text = path.read_text(encoding="utf-8")
+        except OSError:
+            continue
+        for line in text.splitlines():
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            n_supp += 1
+            codes = m.group(1)
+            fams = ({"any"} if codes is None else
+                    {_family(c.strip()) for c in codes.split(",")
+                     if c.strip()})
+            for fam in sorted(fams):
+                supp_by[fam] = supp_by.get(fam, 0) + 1
+    return {
+        "findings": findings_by,
+        "suppressions": supp_by,
+        "total_findings": len(new),
+        "total_suppressions": n_supp,
+        "errors": len(errors),
+    }
 
 
 def main(argv: Optional[list] = None, out=print) -> int:
@@ -162,6 +218,21 @@ def main(argv: Optional[list] = None, out=print) -> int:
             out(f"wrote provenance graph to {args.dump_provenance} "
                 f"({len(prov['edges'])} edge(s))")
 
+    if args.dump_effects:
+        from volsync_tpu.analysis.faultflow import (
+            dump_for_paths as dump_effects,
+        )
+
+        fx = dump_effects(paths)
+        text = json.dumps(fx, indent=2, sort_keys=True)
+        if args.dump_effects == "-":
+            out(text)
+        else:
+            Path(args.dump_effects).write_text(text + "\n",
+                                               encoding="utf-8")
+            out(f"wrote effect graph to {args.dump_effects} "
+                f"({len(fx['edges'])} edge(s))")
+
     baseline_path = Path(args.baseline) if args.baseline else Path(
         DEFAULT_BASELINE)
     if args.write_baseline:
@@ -173,6 +244,11 @@ def main(argv: Optional[list] = None, out=print) -> int:
 
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
     new, suppressed, stale = apply_baseline(findings, baseline)
+
+    if args.stats:
+        out(json.dumps(lint_stats(paths, new, errors), indent=2,
+                       sort_keys=True))
+        return 1 if (new or errors) else 0
 
     if args.format in ("json", "sarif"):
         if args.format == "sarif":

@@ -307,6 +307,18 @@ def run_project(paths: Iterable[str],
 
         buf_sum = buf_summaries(index)
 
+    # fault-path facts (per-function store effects with their retry
+    # layers, raise types) are the VL6xx analogue: cached per file so a
+    # warm run replays VL6 findings without re-running the effect walk
+    fx_sum: dict = {}
+    if any(str(getattr(r, "code", "")).startswith("VL6")
+           for r in project_rules):
+        from volsync_tpu.analysis.faultflow import (
+            summaries_for as fx_summaries,
+        )
+
+        fx_sum = fx_summaries(index)
+
     findings: list[Finding] = []
     new_cache: dict[str, dict] = {}
     for relpath in sorted(parsed):
@@ -316,6 +328,7 @@ def run_project(paths: Iterable[str],
             shapes_entry = shape_sum.get(relpath, {})
             locks_entry = lock_sum.get(relpath, {})
             buf_entry = buf_sum.get(relpath, {})
+            fx_entry = fx_sum.get(relpath, {})
         else:
             file_findings = [_finding_from_row(relpath, row)
                              for row in old_entry.get("findings", [])]
@@ -324,6 +337,7 @@ def run_project(paths: Iterable[str],
             locks_entry = old_entry.get("locks",
                                         lock_sum.get(relpath, {}))
             buf_entry = old_entry.get("buf", buf_sum.get(relpath, {}))
+            fx_entry = old_entry.get("fx", fx_sum.get(relpath, {}))
         findings.extend(file_findings)
         new_cache[relpath] = {
             "hash": hashes[relpath],
@@ -336,6 +350,7 @@ def run_project(paths: Iterable[str],
             "shapes": shapes_entry,
             "locks": locks_entry,
             "buf": buf_entry,
+            "fx": fx_entry,
         }
 
     if cache_path is not None and not errors:

@@ -829,9 +829,10 @@ def default_project_rules() -> list:
         UnsyncPublicationRule,
     )
     from volsync_tpu.analysis.bufflow import default_buf_rules
+    from volsync_tpu.analysis.faultflow import default_fx_rules
     from volsync_tpu.analysis.lockflow import LockOrderRule
 
     return [LockRegionRule(), ThreadLifecycleRule(), ResourceLeakRule(),
             TracerTaintRule(), LockOrderRule(), GuardedFieldRule(),
             CheckThenActRule(), UnsyncPublicationRule(),
-            *default_buf_rules()]
+            *default_buf_rules(), *default_fx_rules()]

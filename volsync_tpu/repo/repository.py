@@ -76,6 +76,32 @@ def quarantine_key(pack_id: str) -> str:
     not yet been healed + re-verified."""
     return f"quarantine/{pack_id}"
 
+
+#: Key families whose publishes MUST be dominated by a _guard_publish
+#: fence re-check on every path (docs/robustness.md, multi-writer
+#: protocol): a taken-over zombie writer must not land an index delta,
+#: snapshot manifest, or prune manifest after its generation is fenced.
+#: The VL604 analyzer (analysis/faultflow.py) proves this statically.
+FENCED_KEY_FAMILIES = ("index/", "snapshots/", "pending-delete/")
+
+#: Declared two-phase write orders, proved by the VL605 analyzer as
+#: statement order in the named function: a crash between adjacent
+#: steps must leave a recoverable store (the chaos matrix in
+#: tests/test_chaos.py crashes at every boundary; this pins the order
+#: itself). Step vocabulary: a bare name is a call to that function;
+#: "delete-prefix:<p>" a store delete of that key family;
+#: "delete-of:<var>" a store delete iterating that variable.
+CRASH_ORDERINGS = {
+    "repo.prune": ("_prune_locked", (
+        "_flush_data",                # rescued blobs durable first
+        "_write_pending_manifest",    # mark new victims (two-phase)
+        "_write_consolidated_index",  # publish the post-prune index
+        "delete-of:superseded",       # then retire superseded deltas
+        "delete-prefix:data/",        # then sweep expired packs
+        "delete-of:sweep_keys",       # manifests retired last
+    )),
+}
+
 _VERIFIER_PLAINTEXT = b"volsync-tpu repository key verifier v1"
 _COMPRESS_MIN_GAIN = 0.9  # keep compressed form only if <= 90% of raw
 
@@ -596,7 +622,13 @@ class Repository:
             def refresh():
                 while not stop.wait(self.LOCK_REFRESH_SECONDS):
                     try:
-                        refresh_policy.call(restamp)
+                        # Single retry budget: restamp's get/put already
+                        # retry inside a ResilientStore; only a bare
+                        # store needs the policy wrap (VL602).
+                        if self._store_retries:
+                            restamp()
+                        else:
+                            refresh_policy.call(restamp)
                     except Exception as ex:  # noqa: BLE001 — log, don't
                         # swallow silently; keep holding (the next beat
                         # re-stamps, staleness only bites after
@@ -715,7 +747,11 @@ class Repository:
             # local writer; pool workers never take this lock.
             reload_policy = RetryPolicy.from_env(
                 "repo.index_reload", max_attempts=4, base_delay=0.02,
-                max_delay=0.5, retryable=(_IndexReloadRace,))
+                max_delay=0.5, retryable=(_IndexReloadRace,),
+                # Scoped policy: retries ONLY the list/get race above
+                # (retryable= is checked first) — store weather is the
+                # ResilientStore wrap's budget, not ours (VL602).
+                classify_fn=lambda exc: False)
             fresh, pending = reload_policy.call(self._read_index_snapshot)
             self._index = fresh
             self._pending_packs = pending

@@ -64,6 +64,19 @@ from volsync_tpu.repo.repository import (
 
 log = logging.getLogger("volsync_tpu.repo.scrub")
 
+#: Declared scrub write order, proved statically by the VL605 analyzer
+#: (analysis/faultflow.py): quarantine the evidence BEFORE attempting
+#: the mirror heal, and drop the quarantine manifest only after the
+#: heal — a crash at any boundary leaves either the manifest or a
+#: healthy pack, never silent corruption.
+CRASH_ORDERINGS = {
+    "scrub.heal": ("_scrub_pack", (
+        "_quarantine",                 # evidence first (crash-safe)
+        "_heal",                       # verify-then-replace overwrite
+        "delete-prefix:quarantine/",   # manifest retired last
+    )),
+}
+
 # Module-cached label children (PR 6/8 convention: resolve once at
 # import, not per pack).
 _M_CLEAN = GLOBAL_METRICS.scrub_packs.labels(outcome="clean")

@@ -458,6 +458,15 @@ def _payload_bytes(op: str, args: tuple, kwargs: dict, result) -> int:
 _RETRIED_OPS = ("put", "get", "get_range", "exists", "delete", "size",
                 "put_file", "get_file")
 
+#: Ops that are single-attempt BY DESIGN: retrying them needs an
+#: argued-safe policy at the call site, never the blanket wrap.
+#: ``put_if_absent`` is the fence/marker primitive — a blind replay
+#: after an ambiguous failure could observe its own first attempt and
+#: misreport "lost"; Repository._claim_marker documents the safe retry.
+#: The VL601 analyzer (analysis/faultflow.py) exempts these sites the
+#: way VL505 sanctions copy sites.
+SINGLE_ATTEMPT_OPS = frozenset({"put_if_absent"})
+
 
 class ResilientStore:
     """Any ObjectStore, wrapped in the shared retry policy + breaker.
