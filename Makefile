@@ -3,10 +3,10 @@
 # tier-1 command in ROADMAP.md.
 
 .PHONY: lint lint-locks lint-buf lint-fx test chaos chaos-concurrent chaos-fleet \
-	chaos-restore chaos-scrub scrub-smoke static-check \
+	chaos-restore chaos-scrub chaos-ec scrub-smoke static-check \
 	bench-index-smoke service-bench-smoke fleet-bench-smoke \
 	restore-bench-smoke copies-smoke syncplan-bench-smoke \
-	trace-smoke session-smoke clean-lint
+	ec-bench-smoke trace-smoke session-smoke clean-lint
 
 # Cached SARIF lint over the whole tree (package + scripts/ + bench.py):
 # all rule families, VL001-VL005 + VL105/VL106 + VL301 per-file + VL101-VL104
@@ -104,6 +104,18 @@ chaos-scrub:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_scrub_chaos.py \
 	    tests/test_restorepipe.py -q -m 'not slow' -p no:cacheprovider
 
+# Erasure-coded durability drill (docs/robustness.md, "Erasure coding
+# & online repack"): the GF(2^8) Reed-Solomon kernel goldens
+# (device ≡ NumPy oracle), EC-armed seal layout + any-k restores,
+# heal-arm priority (mirror-first with exactly one GET, then stripe
+# reconstruction, then quarantine below k), RepackService
+# crash-at-every-boundary safety, and seeded vanish+bitflip storms
+# under live backup/restore/repack/GC traffic — every drill ends
+# quarantine-empty, check-clean, byte-identical.
+chaos-ec:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ec_chaos.py \
+	    tests/test_rs.py -q -m 'not slow' -p no:cacheprovider
+
 static-check:
 	scripts/static_check.sh
 
@@ -153,6 +165,14 @@ copies-smoke:
 # and the bench JSON contract stays runnable.
 syncplan-bench-smoke:
 	python bench.py syncplan --smoke
+
+# Erasure-coding bench at smoke scale (docs/performance.md): device vs
+# NumPy GF(2^8) encode/decode throughput, reconstruct-vs-mirror-fetch
+# latency, and the measured storage overhead asserted at <= 1.5x.
+# Scale-accurate numbers need the full run: `python bench.py ec`
+# (committed artifact: BENCH_EC_r01.json).
+ec-bench-smoke:
+	python bench.py ec --smoke
 
 # Flight-recorder gate (docs/observability.md): a tiny pipelined backup
 # under a tenant-tagged trace must export a Perfetto-loadable

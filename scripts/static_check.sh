@@ -90,6 +90,16 @@
 #      concurrent backup — quarantine-empty, check-clean,
 #      byte-identical restores, plus the read-repair suite
 #      (docs/robustness.md, "Silent corruption & scrub").
+#  15. The erasure-coding drill (`make chaos-ec`): RS kernel goldens,
+#      EC-armed seal layout + any-k restores, heal-arm priority
+#      (mirror-first, then stripe reconstruction, then quarantine),
+#      RepackService crash-at-every-boundary safety, seeded
+#      vanish+bitflip storms under live traffic (docs/robustness.md,
+#      "Erasure coding & online repack").
+#  16. The erasure-coding bench at smoke scale
+#      (`make ec-bench-smoke`): device vs NumPy GF(2^8) encode/decode,
+#      reconstruct-vs-mirror latency, and the measured storage
+#      overhead asserted at <= 1.5x (docs/performance.md).
 #
 # Run from the repo root before pushing data-plane changes.
 set -euo pipefail
@@ -192,5 +202,11 @@ make --no-print-directory scrub-smoke
 
 echo "== chaos-scrub =="
 make --no-print-directory chaos-scrub
+
+echo "== chaos-ec =="
+make --no-print-directory chaos-ec
+
+echo "== ec-bench-smoke =="
+make --no-print-directory ec-bench-smoke > /dev/null
 
 echo "static_check: OK"

@@ -653,6 +653,70 @@ def test_bitflip_masked_by_louder_fault_not_recorded():
     assert fs.get("k") == b"v"  # both at=1 counters consumed
 
 
+def test_vanish_parse_spec_roundtrip():
+    spec = parse_spec("vanish:at=2,op=put,prefix=ec/")[0]
+    assert spec == FaultSpec(kind="vanish", at=2, op="put",
+                             key_prefix="ec/")
+
+
+def test_vanish_landed_then_lost_then_resurrected():
+    """The lost-object fault class: the triggering op completes, the
+    object physically lands, then every read of that key answers
+    absence — until a later write resurrects it (the EC heal arm's
+    backfill PUT)."""
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=3, specs=[
+                        FaultSpec(kind="vanish", at=1, op="put",
+                                  key_prefix="ec/p/")]))
+    fs.put("ec/p/0", b"shard-bytes")
+    assert fs.inner.exists("ec/p/0")        # it DID land
+    assert fs.exists("ec/p/0") is False     # ...and then was lost
+    with pytest.raises(NoSuchKey):
+        fs.get("ec/p/0")
+    with pytest.raises(NoSuchKey):
+        fs.get_range("ec/p/0", 0, 4)
+    with pytest.raises(NoSuchKey):
+        fs.size("ec/p/0")
+    assert list(fs.list("ec/p/")) == []     # listings omit it too
+    assert [k for (_, _, _, k) in fs.injected] == ["vanish"]
+    fs.put("ec/p/0", b"healed")             # resurrection
+    assert fs.get("ec/p/0") == b"healed"
+    assert list(fs.list("ec/p/")) == ["ec/p/0"]
+
+
+def test_vanish_distinct_from_crash_store_stays_alive():
+    """vanish kills one KEY; crash kills the STORE. Other keys keep
+    answering normally after a vanish."""
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="vanish", at=1, op="put",
+                                  key_prefix="ec/a")]))
+    fs.put("ec/a", b"x")
+    fs.put("ec/b", b"y")
+    with pytest.raises(NoSuchKey):
+        fs.get("ec/a")
+    assert fs.get("ec/b") == b"y"
+    assert fs.crashed is False
+
+
+def test_vanish_reads_do_not_advance_spec_counters():
+    """Reads of a vanished key never reached an object, so they must
+    not consume at=N budgets of other specs (the partition-freeze
+    rule applied to lost keys)."""
+    fs = FaultStore(MemObjectStore(),
+                    FaultSchedule(seed=0, specs=[
+                        FaultSpec(kind="vanish", at=1, op="put"),
+                        FaultSpec(kind="transient", at=2, op="get")]))
+    fs.put("k", b"v")
+    for _ in range(5):  # five absent reads: counter must not move
+        with pytest.raises(NoSuchKey):
+            fs.get("k")
+    fs.put("k", b"v2")  # resurrect
+    assert fs.get("k") == b"v2"  # transient at=2 counts THIS as get #1
+    with pytest.raises(FaultInjected):
+        fs.get("k")  # ...and fires on get #2
+
+
 def test_fault_latency_sleeps(monkeypatch):
     slept = []
     fs = FaultStore(MemObjectStore(),

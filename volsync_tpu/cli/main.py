@@ -114,6 +114,11 @@ def build_parser() -> argparse.ArgumentParser:
         "scrub", add_help=False,
         help="integrity scrub: on-device pack verify, quarantine + "
              "mirror heal of silent corruption (volsync_tpu.cli.scrub)")
+    sub.add_parser(
+        "repack", add_help=False,
+        help="online repack: rewrite mostly-dead packs into "
+             "erasure-coded stripes, two-phase retire "
+             "(volsync_tpu.cli.repack)")
 
     return parser
 
@@ -141,6 +146,10 @@ def run(argv, contexts: dict, out=print) -> int:
         from volsync_tpu.cli.scrub import main as scrub_main
 
         return scrub_main(list(argv[1:]), out=out)
+    if argv and argv[0] == "repack":
+        from volsync_tpu.cli.repack import main as repack_main
+
+        return repack_main(list(argv[1:]), out=out)
     args = build_parser().parse_args(argv)
     config_dir = Path(args.config_dir)
     try:
@@ -187,7 +196,8 @@ def main(argv=None) -> int:
     """Demo-mode entry: boot a full in-process stack as the 'default'
     context (the operator's packaged entry point wires real state).
     ``volsync lint`` / ``volsync trace`` / ``volsync session`` /
-    ``volsync repair`` / ``volsync scrub`` never need the runtime —
+    ``volsync repair`` / ``volsync scrub`` / ``volsync repack`` never
+    need the runtime —
     dispatch them before the boot so the linter runs in CI containers
     with no cluster state, the flight recorder is readable from a
     half-broken process, ``session status`` works on a host whose
@@ -195,7 +205,7 @@ def main(argv=None) -> int:
     store whose operator stack is exactly what crashed."""
     argv = argv if argv is not None else sys.argv[1:]
     if argv and argv[0] in ("lint", "trace", "session", "repair",
-                            "scrub"):
+                            "scrub", "repack"):
         return run(argv, {})
     from volsync_tpu.operator import OperatorRuntime
 

@@ -28,9 +28,13 @@ module mirrors that work for reads, in four stages:
    primary with one overwriting PUT (verify-then-replace — the
    repo/scrub.py protocol) and the corrupt blobs re-decode from the
    healthy body — so a restore storm survives bit-rot the scrubber
-   has not reached yet. Only when no healthy mirror exists does the
-   mismatch raise, before any byte of that batch is written, and the
-   failed restore leaves no partial file behind.
+   has not reached yet. When no byte-perfect mirror exists the heal
+   falls through to Reed-Solomon RECONSTRUCTION from any k healthy
+   shards of the pack's ``ec/`` stripe (``repo.ec_reconstruct``,
+   which proves the content-addressed pack id before returning).
+   Only when neither arm yields a provable body does the mismatch
+   raise, before any byte of that batch is written, and the failed
+   restore leaves no partial file behind.
 4. **Write** (``restore.write``): verified blobs are written at their
    planned offsets with the serial path's sparse semantics (aligned
    all-zero pages become holes; chunk boundaries are page-aligned, so
@@ -106,7 +110,7 @@ def restore_files_pipelined(tr, jobs: list, stats: dict) -> None:
     repo = tr.repo
     cache = tr.pack_cache
     if cache is None:
-        cache = PackCache(repo.store)
+        cache = PackCache(repo.store, rescue=repo.ec_reconstruct)
     with span("restore.plan"):
         plans, placements, groups = _plan(tr, jobs, stats)
     if not plans:
@@ -169,18 +173,27 @@ def _plan(tr, jobs: list, stats: dict):
 
 def _mirror_heal(repo, cache: PackCache, pack_id: str) -> Optional[bytes]:
     """Read-repair heal: fetch the mirror copy, prove it byte-perfect
-    (the pack id is the SHA-256 of the whole sealed blob), heal the
-    primary with one overwriting PUT — verify-then-replace, never
-    delete-first — and evict the poisoned cache body so every later
-    fetch sees healthy bytes. Returns the healthy body, or None when no
-    byte-perfect mirror exists (single-copy repository, swept mirror,
-    or mirror rot)."""
+    (the pack id is the SHA-256 of the whole sealed blob) — falling
+    through to Reed-Solomon reconstruction from the pack's ``ec/``
+    stripe when no provable mirror exists — then heal the primary with
+    one overwriting PUT (verify-then-replace, never delete-first) and
+    evict the poisoned cache body so every later fetch sees healthy
+    bytes. The mirror arm runs FIRST (one GET beats k shard GETs plus
+    a decode) and costs exactly one mirror fetch. Returns the healthy
+    body, or None when neither arm proves out (single-copy repository,
+    swept mirror, fewer than k provable shards)."""
+    body = None
     try:
-        body = repo.store.get(mirror_key(pack_id))
+        mirror = repo.store.get(mirror_key(pack_id))
+        if hashlib.sha256(mirror).hexdigest() == pack_id:
+            body = mirror
     except NoSuchKey:
-        return None
-    if hashlib.sha256(body).hexdigest() != pack_id:
-        return None
+        pass
+    if body is None:
+        try:
+            body = repo.ec_reconstruct(pack_id)
+        except NoSuchKey:
+            return None
     with span("scrub.heal"):
         repo.store.put(pack_key(pack_id), body)
     cache.invalidate(pack_id)
@@ -382,12 +395,16 @@ class RestoreGroup:
         self._caches: dict[int, PackCache] = {}  # lint: ignore[VL404]
         self._jobs: list[tuple] = []
 
-    def cache_for(self, store) -> PackCache:
+    def cache_for(self, store, rescue=None) -> PackCache:
         """The group's shared cache for ``store`` (one per distinct
-        store object)."""
+        store object). ``rescue`` (first caller wins) is the cache's
+        missing-primary fallback — ec_reconstruct is content-addressed
+        and store-scoped, so any job's repository handle over the same
+        store derives identical bodies."""
         cache = self._caches.get(id(store))
         if cache is None:
-            cache = PackCache(store, budget_bytes=self._budget)
+            cache = PackCache(store, budget_bytes=self._budget,
+                              rescue=rescue)
             self._caches[id(store)] = cache
         return cache
 
@@ -411,7 +428,7 @@ class RestoreGroup:
         # caches are created up front, single-threaded: cache_for is
         # not synchronized and must not race inside the job threads
         for repo, *_ in self._jobs:
-            self.cache_for(repo.store)
+            self.cache_for(repo.store, rescue=repo.ec_reconstruct)
 
         def one(i: int, repo, dest, as_of, previous, delete_extra):
             try:

@@ -368,6 +368,54 @@ def device_verify_enabled() -> bool:
     return env_bool("VOLSYNC_DEVICE_VERIFY", True)
 
 
+# -- erasure coding + online repack (repo/erasure.py, repo/repack.py) ----
+
+def ec_scheme() -> Optional[tuple]:
+    """VOLSYNC_EC_SCHEME: ``k+m`` (e.g. ``4+2``) arms Reed-Solomon
+    striping — sealed packs are written as k data + m parity shards
+    under ``ec/<pack-id>/<shard-idx>`` instead of primary+mirror, so any
+    m shard losses reconstruct at (k+m)/k storage. None (the default)
+    keeps the classic layout; malformed or out-of-range specs degrade
+    to None (a typo'd scheme must not silently change the durability
+    story — the pack_copies mirror fallback still applies)."""
+    raw = env_str("VOLSYNC_EC_SCHEME")
+    if raw is None:
+        return None
+    parts = raw.strip().split("+")
+    if len(parts) != 2:
+        return None
+    try:
+        k, m = int(parts[0]), int(parts[1])
+    except ValueError:
+        return None
+    if not (2 <= k <= 16 and 1 <= m <= 8):
+        return None
+    return (k, m)
+
+
+def repack_dead_ratio() -> float:
+    """VOLSYNC_REPACK_DEAD_RATIO: fraction of a pack's entries that must
+    be dead (unreferenced by the index) before RepackService rewrites
+    its live blobs into a fresh erasure-coded stripe. Clamped to
+    [0.05, 1.0]: 0 would repack every pack every cycle."""
+    v = env_float("VOLSYNC_REPACK_DEAD_RATIO", 0.3, minimum=0.05)
+    return min(v, 1.0)
+
+
+def repack_interval_seconds() -> float:
+    """VOLSYNC_REPACK_INTERVAL_S: pause between continuous-repack cycles
+    (repo/repack.py). Each cycle is one bounded pick-rewrite-retire pass
+    under the shared prune lock rules."""
+    return env_float("VOLSYNC_REPACK_INTERVAL_S", 60.0, minimum=0.1)
+
+
+def repack_packs_per_cycle() -> int:
+    """VOLSYNC_REPACK_PACKS: packs rewritten per repack cycle. 0 (the
+    default) repacks every eligible pack each cycle — right for tests
+    and the one-shot ``volsync repack`` verb; fleets set a budget."""
+    return env_int("VOLSYNC_REPACK_PACKS", 0, minimum=0)
+
+
 # -- observability (obs/tracing.py) --------------------------------------
 
 def trace_dir() -> Optional[str]:

@@ -334,6 +334,22 @@ class Metrics:
             "scrub",
             registry=self.registry,
         )
+        # Online repack (repo/repack.py): cycles by outcome — "ok"
+        # (packs restriped and/or retired stripes swept), "clean"
+        # (nothing fragmented enough), "contended", "fenced", "error"
+        # (the ContinuousGC ladder) — plus packs rewritten into
+        # erasure-coded stripes.
+        self.repack_cycles = Counter(
+            "volsync_repack_cycles_total",
+            "Online-repack cycles, by outcome",
+            ["outcome"], registry=self.registry,
+        )
+        self.repack_packs = Counter(
+            "volsync_repack_packs_total",
+            "Packs rewritten into erasure-coded stripes by the online "
+            "repacker",
+            registry=self.registry,
+        )
         # Copy ledger (obs/copyledger.py): host bytes memcpy'd at the
         # SANCTIONED copy sites of the zero-copy data plane — every
         # remaining staging copy on the backup/restore hot paths is

@@ -34,6 +34,17 @@ ObjectStore and injects faults according to a seeded ``FaultSchedule``:
                     fleet drill's mid-outage failover rides this.
                     While partitioned, other specs' counters do not
                     advance (those ops never arrived at the store).
+- ``vanish``      — a landed object LATER disappears: the triggering
+                    op completes normally, then every subsequent
+                    ``get``/``get_range``/``size`` of that key raises
+                    ``NoSuchKey``, ``exists`` says False, and listings
+                    omit it — the lost-shard / lost-replica fault
+                    class (an object a bucket audit can no longer
+                    find). Distinct from ``crash``'s sticky death
+                    (only the KEY dies, the store lives) and from
+                    ``delete`` (no client ever asked). A later PUT of
+                    the key resurrects it — which is exactly what the
+                    erasure-coding heal arms must be able to do.
 - ``bitflip``     — SILENT corruption: a ``get``/``get_range`` payload
                     comes back with ``nbytes=`` byte positions XORed
                     (default 1) and NO exception raised — the bit-rot /
@@ -77,6 +88,7 @@ from typing import Iterator, Optional
 
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
+from volsync_tpu.objstore.store import NoSuchKey
 from volsync_tpu.obs import record_trigger
 from volsync_tpu.resilience import ThrottleError, TransientError
 
@@ -106,17 +118,25 @@ class InjectedPartition(TransientError):
     keeps trying past the window succeeds)."""
 
 
+class _Vanished(Exception):
+    """Internal signal: the key is in the vanished set — surfaced to
+    callers as NoSuchKey (or False from exists), never raised out."""
+
+
 #: default blocked time for a ``hang`` spec that carries no ``ms=``
 _HANG_DEFAULT_S = 60.0
 #: default outage length for a ``partition`` spec that carries no ``ms=``
 _PARTITION_DEFAULT_S = 5.0
 
 _KINDS = ("transient", "throttle", "latency", "partial_put",
-          "truncated_read", "crash", "hang", "partition", "bitflip")
+          "truncated_read", "crash", "hang", "partition", "bitflip",
+          "vanish")
 #: ops that mutate the store — the ones ``landed`` applies to
 _WRITE_OPS = ("put", "put_if_absent", "delete")
 #: ops returning a payload — the only ones ``bitflip`` can corrupt
 _PAYLOAD_OPS = ("get", "get_range")
+#: ops a vanished key answers "no such object" to (writes resurrect)
+_VANISH_OPS = ("get", "get_range", "size", "exists")
 
 
 @dataclass(frozen=True)
@@ -231,6 +251,9 @@ class FaultStore:
         self._clock = clock
         self._partition_until = 0.0
         self._lock = lockcheck.make_lock("objstore.faults")
+        # keys currently "lost" by a vanish fault (sticky until a
+        # write of that key lands again)
+        self._vanished: set[str] = set()
         self._op_count = 0
         # per-spec matching-op counters (for at=N) and per-(op,key)
         # occurrence counters (for the pure-hash rolls)
@@ -257,6 +280,11 @@ class FaultStore:
                 raise InjectedPartition(
                     f"store partitioned; {op} {key!r} unreachable for "
                     f"{self._partition_until - self._clock():.3f}s more")
+            if key in self._vanished and op in _VANISH_OPS:
+                # the object is "lost": reads answer absence without
+                # advancing any spec counter (they never reached a
+                # real object) — writes fall through and resurrect
+                raise _Vanished(key)
             self._op_count += 1
             opix = self._op_count
             n = self._occurrence.get((op, key), 0) + 1
@@ -270,7 +298,10 @@ class FaultStore:
                        else self.schedule.roll(i, op, key, n) < spec.p)
                 if hit:
                     fired.append(spec)
-                    if spec.kind != "bitflip":
+                    if spec.kind not in ("bitflip", "vanish"):
+                        # bitflip/vanish record in _apply, only once
+                        # the op actually succeeded (a louder spec on
+                        # the same arrival masks them)
                         self.injected.append((opix, op, key, spec.kind))
             if any(s.kind == "crash" for s in fired):
                 self.crashed = True
@@ -299,7 +330,13 @@ class FaultStore:
         """Run one op under the schedule. ``execute()`` performs the
         real operation; ``torn_execute()`` (writes only) performs the
         truncated form for partial_put."""
-        fired, opix, n = self._decide(op, key)
+        try:
+            fired, opix, n = self._decide(op, key)
+        except _Vanished:
+            record_trigger("fault", op=op, key=key, kinds=["vanish"])
+            if op == "exists":
+                return False
+            raise NoSuchKey(f"{key} (vanished by fault injection)")
         if fired:
             # flight-recorder annotation, outside self._lock (_decide
             # released it) so the dump can never nest under it
@@ -328,6 +365,15 @@ class FaultStore:
             raise InjectedCrash(f"injected crash at {op} {key!r}")
         if err is None:
             result = execute()
+            if op in _WRITE_OPS:
+                with self._lock:
+                    # a landed write replaces (or truly removes) the
+                    # object: the key stops being "lost"
+                    self._vanished.discard(key)
+            if any(s.kind == "vanish" for s in fired):
+                with self._lock:
+                    self._vanished.add(key)
+                self.injected.append((opix, op, key, "vanish"))
             flips = [s for s in fired if s.kind == "bitflip"]
             if flips:
                 # silent wrong-bytes: the op SUCCEEDS and the caller
@@ -406,9 +452,13 @@ class FaultStore:
 
     def list(self, prefix: str = "") -> Iterator[str]:
         # materialized so the fault decision covers the whole listing,
-        # not just the first page pull
-        return iter(self._apply("list", prefix,
-                                lambda: list(self.inner.list(prefix))))
+        # not just the first page pull; vanished keys are omitted (a
+        # lost object stops appearing in bucket listings too)
+        keys = self._apply("list", prefix,
+                           lambda: list(self.inner.list(prefix)))
+        with self._lock:
+            gone = set(self._vanished)
+        return iter([k for k in keys if k not in gone])
 
     # file transfer rides the byte path so the schedule applies to it
     # (bounded memory is irrelevant at chaos-test scale)

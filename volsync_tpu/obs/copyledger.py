@@ -52,6 +52,8 @@ SANCTIONED_SITES = frozenset({
     "objstore.assemble",   # iovec joined for a contiguous-transport backend
     "repo.buffered_read",  # blob read back while still in the write pipeline
     "svc.frame",           # gRPC frame materialization (protobuf wants bytes)
+    "ec.encode",           # field-lane packing + shard blob materialization
+    "ec.decode",           # device->host shard copy-out + body assembly
 })
 
 

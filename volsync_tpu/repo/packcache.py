@@ -38,6 +38,7 @@ from typing import Optional
 from volsync_tpu import envflags
 from volsync_tpu.analysis import lockcheck
 from volsync_tpu.metrics import GLOBAL as GLOBAL_METRICS
+from volsync_tpu.objstore.store import NoSuchKey
 from volsync_tpu.obs import span
 from volsync_tpu.repo.compactindex import as_key_rows
 from volsync_tpu.repo.shardedindex import BloomPrefilter
@@ -71,11 +72,19 @@ class PackCache:
     fetches (module docstring). Thread-safe; one instance may serve
     many concurrent restores (RestoreGroup does exactly that)."""
 
-    def __init__(self, store, *, budget_bytes: Optional[int] = None):
+    def __init__(self, store, *, budget_bytes: Optional[int] = None,
+                 rescue=None):
         self.store = store
         if budget_bytes is None:
             budget_bytes = envflags.restore_cache_mb() << 20
         self.budget_bytes = budget_bytes
+        # pack_id -> bytes fallback when the primary object is absent
+        # (erasure-coded estates have NO data/ primary: the repository's
+        # ec_reconstruct decodes any k healthy shards and proves the
+        # content-addressed pack id before the body is served). Pure
+        # read — materializing a primary is the heal arms' job, not the
+        # cache's.
+        self.rescue = rescue
         self._lru: "OrderedDict[str, bytes]" = OrderedDict()
         self._bytes = 0
         self._inflight: dict[str, _Flight] = {}
@@ -128,7 +137,12 @@ class PackCache:
             return flight.body
         try:
             with span("restore.fetch"):
-                body = self.store.get(f"data/{pack_id[:2]}/{pack_id}")
+                try:
+                    body = self.store.get(f"data/{pack_id[:2]}/{pack_id}")
+                except NoSuchKey:
+                    if self.rescue is None:
+                        raise
+                    body = self.rescue(pack_id)
         except BaseException as e:  # noqa: BLE001 — every waiter of
             # this flight must see the leader's failure, whatever it is
             flight.error = e

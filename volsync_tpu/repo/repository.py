@@ -21,6 +21,11 @@ Layout in the object store:
     pending-delete/<id>         two-phase prune manifests (marked packs)
     mirror/<pack-id>            second pack copy (VOLSYNC_PACK_COPIES=2):
                                 the heal source for scrub + read-repair
+    ec/<pack-id>/<idx>          Reed-Solomon shard (VOLSYNC_EC_SCHEME=k+m):
+                                packs sealed while the scheme is armed
+                                store ONLY their k+m shards — any k
+                                reconstruct the body at (k+m)/k storage
+                                (repo/erasure.py; mirrors stay 2.0x)
     quarantine/<pack-id>        scrub corruption manifest; removed after a
                                 successful mirror heal + re-verify
 
@@ -77,12 +82,25 @@ def quarantine_key(pack_id: str) -> str:
     return f"quarantine/{pack_id}"
 
 
+def ec_shard_key(pack_id: str, idx: int) -> str:
+    """Store key of shard ``idx`` of a pack's k+m erasure-coded stripe
+    (VOLSYNC_EC_SCHEME=k+m). Packs sealed while the scheme is armed
+    write ONLY these shards — no primary, no mirror — so the estate
+    carries (k+m)/k bytes per logical byte instead of 2x."""
+    return f"ec/{pack_id}/{idx}"
+
+
+def ec_pack_prefix(pack_id: str) -> str:
+    """List prefix covering every shard of one pack's stripe."""
+    return f"ec/{pack_id}/"
+
+
 #: Key families whose publishes MUST be dominated by a _guard_publish
 #: fence re-check on every path (docs/robustness.md, multi-writer
 #: protocol): a taken-over zombie writer must not land an index delta,
 #: snapshot manifest, or prune manifest after its generation is fenced.
 #: The VL604 analyzer (analysis/faultflow.py) proves this statically.
-FENCED_KEY_FAMILIES = ("index/", "snapshots/", "pending-delete/")
+FENCED_KEY_FAMILIES = ("index/", "snapshots/", "pending-delete/", "ec/")
 
 #: Declared two-phase write orders, proved by the VL605 analyzer as
 #: statement order in the named function: a crash between adjacent
@@ -98,6 +116,7 @@ CRASH_ORDERINGS = {
         "_write_consolidated_index",  # publish the post-prune index
         "delete-of:superseded",       # then retire superseded deltas
         "delete-prefix:data/",        # then sweep expired packs
+        "delete-of:ec_keys",          # a swept pack's shards follow it
         "delete-of:sweep_keys",       # manifests retired last
     )),
 }
@@ -339,6 +358,19 @@ class Repository:
         #: mirror/<pack-id> (the scrub/read-repair heal source); each
         #: copy rides the same resilient upload path as the primary.
         self.pack_copies = envflags.pack_copies()
+        #: VOLSYNC_EC_SCHEME=k+m arms Reed-Solomon striping: sealed
+        #: packs land as k+m shards under ec/<pack-id>/<idx> INSTEAD of
+        #: primary+mirror — any m shard losses reconstruct at (k+m)/k
+        #: storage (repo/erasure.py). None keeps the classic layout;
+        #: pre-existing primary/mirror packs are read as before.
+        self.ec_scheme = envflags.ec_scheme()
+        # Tiny verified-reconstruct memo: one heal or restore burst
+        # touches the same shard-only pack repeatedly (existence probe
+        # plus every blob read); the memo bounds that to one k-shard
+        # fetch + decode. Entries are content-addressed (pack id fixes
+        # the bytes), so they can never go stale.
+        self._ec_memo: dict[str, bytes] = {}
+        self._ec_memo_lock = lockcheck.make_lock("repo.ec_memo")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1138,9 +1170,12 @@ class Repository:
                 h.update(p)
             pack_id = h.hexdigest()
             with span("repo.pack_upload"):
-                self._put_pack_blob(pack_key(pack_id), parts)
-                if self.pack_copies >= 2:
-                    self._put_pack_blob(mirror_key(pack_id), parts)
+                if self.ec_scheme is not None:
+                    self._put_ec_shards(pack_id, parts)
+                else:
+                    self._put_pack_blob(pack_key(pack_id), parts)
+                    if self.pack_copies >= 2:
+                        self._put_pack_blob(mirror_key(pack_id), parts)
             return pack_id
         finally:
             self._pl_upload_slots.release()
@@ -1154,6 +1189,99 @@ class Repository:
             self.store.put(key, blob)
         else:
             self._upload_policy.call(self.store.put, key, blob)
+
+    # -- erasure-coded pack layout (VOLSYNC_EC_SCHEME) -----------------------
+
+    def _put_ec_shards(self, pack_id: str, parts) -> None:
+        """Seal one pack as its k+m Reed-Solomon shards
+        (ec/<pack-id>/<idx>) INSTEAD of primary+mirror — the (k+m)/k
+        storage layout. ec/ is a fenced key family: the fence is
+        re-checked before any shard lands, so a taken-over zombie
+        writer cannot publish a stripe. Each shard put carries exactly
+        one retry layer (the constructor's no-stacking rule)."""
+        from volsync_tpu.repo import erasure
+
+        k, m = self.ec_scheme
+        shards = erasure.encode_pack_shards(parts, k, m)
+        self._guard_publish("ec shard publish")
+        if self._store_retries:
+            for idx, shard in enumerate(shards):
+                self.store.put(ec_shard_key(pack_id, idx), shard)
+        else:
+            for idx, shard in enumerate(shards):
+                self._upload_policy.call(
+                    self.store.put, ec_shard_key(pack_id, idx), shard)
+
+    def ec_publish_shard(self, pack_id: str, idx: int,
+                         shard: bytes) -> None:
+        """Publish ONE shard of an existing stripe (the scrub's shard
+        backfill and RepackService route their ec/ writes through here
+        so every shard publish shares the same fence check)."""
+        self._guard_publish("ec shard publish")
+        self.store.put(ec_shard_key(pack_id, idx), shard)
+
+    def ec_shard_blobs(self, pack_id: str) -> dict:
+        """Every present shard blob of one pack, keyed by shard index.
+        Unlistable indices and shards deleted mid-scan are skipped —
+        reconstruct_verified cross-checks whatever survives."""
+        blobs: dict[int, bytes] = {}
+        for key in list(self.store.list(ec_pack_prefix(pack_id))):
+            try:
+                idx = int(key.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            try:
+                blobs[idx] = self.store.get(key)
+            except NoSuchKey:
+                continue
+        return blobs
+
+    def ec_reconstruct(self, pack_id: str) -> bytes:
+        """Reconstruct AND prove one pack body from any k healthy
+        shards (repo/erasure.reconstruct_verified re-derives the
+        content-addressed pack id, routing around silently corrupt
+        shards). Pure read — the heal arms own the one overwriting
+        PUT. Raises NoSuchKey when no surviving k-subset proves out,
+        so callers treat an unreconstructable pack exactly like a
+        missing object (quarantine-first semantics)."""
+        from volsync_tpu.repo import erasure
+
+        with self._ec_memo_lock:
+            body = self._ec_memo.get(pack_id)
+        if body is not None:
+            return body
+        blobs = self.ec_shard_blobs(pack_id)
+        body = (erasure.reconstruct_verified(blobs, pack_id)
+                if blobs else None)
+        if body is None:
+            raise NoSuchKey(
+                f"pack {pack_id}: fewer than k provable shards")
+        record_trigger("ec_reconstruct", pack=pack_id,
+                       shards=str(len(blobs)))
+        with self._ec_memo_lock:
+            self._ec_memo[pack_id] = body
+            while len(self._ec_memo) > 4:
+                self._ec_memo.pop(next(iter(self._ec_memo)))
+        return body
+
+    def _ec_present(self, pack_id: str) -> bool:
+        """At least k healthy-LOOKING shards of this pack exist (header
+        probe only — check(read_data=True) and the scrub prove the
+        payloads). The existence answer check()/repair() use for packs
+        that have no data/ primary."""
+        from volsync_tpu.repo import erasure
+
+        keys = list(self.store.list(ec_pack_prefix(pack_id)))
+        if not keys:
+            return False
+        for key in keys:
+            try:
+                hdr = self.store.get_range(key, 0, erasure.HEADER_LEN)
+                k = erasure.parse_shard(hdr)[0]
+            except (NoSuchKey, erasure.ECError):
+                continue
+            return len(keys) >= k
+        return False
 
     def _pl_reap(self, block: bool):
         """Register completed uploads in FIFO (pack creation) order:
@@ -1230,9 +1358,12 @@ class Repository:
             h.update(p)
         pack_id = h.hexdigest()
         with span("repo.pack_upload"):
-            self.store.put(pack_key(pack_id), parts)
-            if self.pack_copies >= 2:
-                self.store.put(mirror_key(pack_id), parts)
+            if self.ec_scheme is not None:
+                self._put_ec_shards(pack_id, parts)
+            else:
+                self.store.put(pack_key(pack_id), parts)
+                if self.pack_copies >= 2:
+                    self.store.put(mirror_key(pack_id), parts)
         for e in self._cur_entries:
             cur = self._index.lookup(e["id"])
             if (cur is None or cur[0] == ""
@@ -1344,9 +1475,17 @@ class Repository:
         thread holds the lock (prune's rewrite readers).
         ``verify=False`` skips the host re-hash for callers that verify
         in device batches (check's device path)."""
-        sealed = self.store.get_range(
-            f"data/{entry.pack[:2]}/{entry.pack}", entry.offset, entry.length
-        )
+        try:
+            sealed = self.store.get_range(
+                f"data/{entry.pack[:2]}/{entry.pack}", entry.offset,
+                entry.length)
+        except NoSuchKey:
+            # Shard-only pack (EC layout), or a vanished primary with
+            # surviving shards: serve from the proven reconstruction.
+            # Read-only — the scrub/restore heal arms own the PUT that
+            # re-materializes a primary.
+            body = self.ec_reconstruct(entry.pack)
+            sealed = body[entry.offset:entry.offset + entry.length]
         data = self._decode_blob(sealed)
         if verify:
             got = blobid.blob_id(data)
@@ -1768,6 +1907,14 @@ class Repository:
             if (pid not in indexed and pid not in pending_all
                     and pid not in new_victims):
                 orphans.add(pid)
+        # Shard-only packs (EC layout) have no data/ listing; a stripe
+        # a crashed writer never indexed is orphan debris exactly like
+        # an un-indexed primary — same grace window, same sweep.
+        for key in list(self.store.list("ec/")):
+            pid = key.split("/", 2)[1]
+            if (pid not in indexed and pid not in pending_all
+                    and pid not in new_victims):
+                orphans.add(pid)
         if orphans:
             record_trigger("repo_orphan", packs=sorted(orphans),
                            source="prune")
@@ -1827,13 +1974,16 @@ class Repository:
                       | set(self._published_deltas[own_mark:])) - new_keys
         for key in superseded:
             self.store.delete(key)
-        # Step 5: sweep expired packs — primary, mirror copy, and any
-        # stale quarantine manifest ride one sweep (deletes are
-        # idempotent, so a crash between them re-runs safely) — then
-        # their pending-delete manifests.
+        # Step 5: sweep expired packs — primary, mirror copy, erasure
+        # shards, and any stale quarantine manifest ride one sweep
+        # (deletes are idempotent, so a crash between them re-runs
+        # safely) — then their pending-delete manifests.
         for pack in sorted(sweep_packs):
             self.store.delete(pack_key(pack))
             self.store.delete(mirror_key(pack))
+            ec_keys = list(self.store.list(ec_pack_prefix(pack)))
+            for skey in ec_keys:
+                self.store.delete(skey)
             self.store.delete(quarantine_key(pack))
         for key in sweep_keys:
             self.store.delete(key)
@@ -1918,7 +2068,13 @@ class Repository:
                 store_packs = {key.rsplit("/", 1)[1]
                                for key in self.store.list("data/")}
                 indexed = {p for p in self._index.live_packs() if p}
-                dangling_packs = sorted(indexed - store_packs)
+                # A pack with no data/ primary but a reconstructable
+                # stripe is HOME, not dangling (the EC layout never
+                # writes a primary); fewer than k surviving shards is
+                # genuinely dangling and reported as such.
+                dangling_packs = sorted(
+                    p for p in indexed - store_packs
+                    if not self._ec_present(p))
                 orphan_packs = sorted(store_packs - indexed
                                       - self._pending_packs)
                 manifests = self._load_pending_manifests()
@@ -2086,8 +2242,11 @@ class Repository:
                 continue
             ok = packs_seen.get(pack)
             if ok is None:
-                ok = packs_seen[pack] = self.store.exists(
-                    f"data/{pack[:2]}/{pack}")
+                # Primary object OR a reconstructable stripe counts as
+                # present — EC-sealed packs have no data/ primary.
+                ok = packs_seen[pack] = (
+                    self.store.exists(f"data/{pack[:2]}/{pack}")
+                    or self._ec_present(pack))
             if not ok:
                 problems.append(f"blob {blob_id}: pack {pack} missing")
                 continue

@@ -22,19 +22,26 @@ modeled on service/gc.py's ContinuousGC loop:
   plus a ``record_trigger("scrub_corruption")`` flight-recorder
   annotation BEFORE any heal is attempted, so a crash mid-heal leaves
   the evidence behind.
-- **heal** — verify-then-replace from the mirror copy
+- **heal** — verify-then-replace, mirror arm first: the mirror body
   (``VOLSYNC_PACK_COPIES=2`` writes ``mirror/<pack-id>`` next to every
-  primary): the mirror body must re-derive the content-addressed pack
-  id AND pass device verify before one overwriting PUT replaces the
-  primary — never delete-first, so no reader ever sees a missing
-  pack. The poisoned ``PackCache`` entry is invalidated and the
-  healed primary RE-verified through the same fetch path; only then
-  is the quarantine manifest removed. A clean pack with a missing or
-  rotten mirror is re-mirrored from the verified primary (which also
-  backfills mirrors for repositories that enabled copies=2 late).
-- **escalate** — no healthy mirror means outcome ``unhealable``: the
-  quarantine manifest stays, ``record_trigger("scrub_corruption")``
-  fires again with ``unhealable=True``, and ``volsync scrub`` exits 2.
+  primary) must re-derive the content-addressed pack id AND pass
+  device verify before one overwriting PUT replaces the primary —
+  never delete-first, so no reader ever sees a missing pack. With no
+  healthy mirror the RECONSTRUCT arm (VOLSYNC_EC_SCHEME estates)
+  decodes the body from any k healthy ``ec/<pack-id>/<idx>`` shards,
+  re-derives the pack id, device-verifies, and lands the same single
+  overwriting PUT. The poisoned ``PackCache`` entry is invalidated
+  and the healed primary RE-verified through the same fetch path;
+  only then is the quarantine manifest removed. A clean pack with a
+  missing or rotten mirror is re-mirrored from the verified primary
+  (which also backfills mirrors for repositories that enabled
+  copies=2 late); a proven stripe with missing or rotten shards gets
+  those shards re-published the same way (shard backfill).
+- **escalate** — no healthy mirror AND no k provable shards means
+  outcome ``unhealable``: the quarantine manifest stays,
+  ``record_trigger("scrub_corruption")`` fires again with
+  ``unhealable=True``, and ``volsync scrub`` exits 2 — the pack is
+  never silently served.
 
 Outcomes export as ``volsync_scrub_packs_total{outcome}`` +
 ``volsync_scrub_bytes_total``; engine/restorepipe.py's read-repair
@@ -257,9 +264,9 @@ class ScrubService:
         try:
             body = self._cache.get_pack(pack_id)
         except NoSuchKey:
-            # a prune swept it between the index snapshot and the
-            # fetch — nothing to scrub
-            return "skipped"
+            # No primary object: an EC-sealed pack (shards only), or a
+            # prune swept it between the index snapshot and the fetch.
+            return self._scrub_stripe(repo, pack_id, entries)
         self.packs_scrubbed += 1
         self.bytes_scrubbed += len(body)
         _M_BYTES.inc(len(body))
@@ -288,6 +295,38 @@ class ScrubService:
         self.unhealable += 1
         return "unhealable"
 
+    def _scrub_stripe(self, repo, pack_id: str,
+                      entries: list[tuple[str, int, int]]) -> str:
+        """Scrub a pack with NO primary object. No shards either means
+        a prune swept it (skip). Otherwise reconstruct-AND-prove the
+        body from any k shards, device-verify every blob, and backfill
+        whatever shards rotted or vanished; fewer than k provable
+        shards quarantines and escalates unhealable — the stripe is
+        never silently served."""
+        blobs = repo.ec_shard_blobs(pack_id)
+        if not blobs:
+            return "skipped"
+        from volsync_tpu.repo import erasure
+
+        self.packs_scrubbed += 1
+        body = erasure.reconstruct_verified(blobs, pack_id)
+        if body is None or verify_pack_blobs(repo, body, entries):
+            self.corruptions += 1
+            self._quarantine(repo, pack_id, [e[0] for e in entries])
+            record_trigger("scrub_corruption", pack=pack_id,
+                           unhealable=True)
+            _M_UNHEALABLE.inc()
+            self.unhealable += 1
+            return "unhealable"
+        self.bytes_scrubbed += len(body)
+        _M_BYTES.inc(len(body))
+        if self._ec_backfill(repo, pack_id, blobs, body):
+            _M_HEALED.inc()
+            self.healed += 1
+            return "healed"
+        _M_CLEAN.inc()
+        return "clean"
+
     # -- quarantine + heal -------------------------------------------------
 
     def _quarantine(self, repo, pack_id: str, bad: list[str]) -> None:
@@ -305,29 +344,50 @@ class ScrubService:
 
     def _heal(self, repo, pack_id: str,
               entries: list[tuple[str, int, int]]) -> bool:
-        """Verify-then-replace from the mirror; True only after the
-        healed primary RE-verifies through a fresh fetch."""
+        """Verify-then-replace; True only after the healed primary
+        RE-verifies through a fresh fetch. Mirror arm first (one GET —
+        the PR 14 law), reconstruct arm otherwise: any k healthy
+        shards decode a candidate body whose content-addressed pack id
+        is re-derived before it may become the primary. Either way the
+        replacement lands as ONE overwriting PUT, never delete-first."""
         assert self._cache is not None
-        try:
-            mirror_body = repo.store.get(mirror_key(pack_id))
-        except NoSuchKey:
+        body = self._healthy_body(repo, pack_id, entries)
+        if body is None:
             return False
-        # the pack id is the SHA-256 of the whole sealed blob, so one
-        # host hash proves the mirror byte-perfect (header included)...
-        if hashlib.sha256(mirror_body).hexdigest() != pack_id:
-            return False
-        # ...and the device batch re-proves every blob payload before
-        # the mirror is allowed to become the primary
-        if verify_pack_blobs(repo, mirror_body, entries):
-            return False
-        repo.store.put(pack_key(pack_id), mirror_body)  # overwrite, not
-        #                                                 delete-first
+        repo.store.put(pack_key(pack_id), body)  # overwrite, not
+        #                                          delete-first
         self._cache.invalidate(pack_id)
         try:
             fresh = self._cache.get_pack(pack_id)
         except NoSuchKey:
             return False
         return not verify_pack_blobs(repo, fresh, entries)
+
+    def _healthy_body(self, repo, pack_id: str,
+                      entries: list[tuple[str, int, int]]):
+        """A proven replacement body, or None: the mirror when it
+        re-derives the pack id and device-verifies, else the verified
+        reconstruction from any k healthy shards."""
+        try:
+            mirror_body = repo.store.get(mirror_key(pack_id))
+        except NoSuchKey:
+            mirror_body = None
+        if mirror_body is not None:
+            # the pack id is the SHA-256 of the whole sealed blob, so
+            # one host hash proves the mirror byte-perfect (header
+            # included)... and the device batch re-proves every blob
+            # payload before the mirror may become the primary
+            if (hashlib.sha256(mirror_body).hexdigest() == pack_id
+                    and not verify_pack_blobs(repo, mirror_body,
+                                              entries)):
+                return mirror_body
+        try:
+            body = repo.ec_reconstruct(pack_id)
+        except NoSuchKey:
+            return None
+        if verify_pack_blobs(repo, body, entries):
+            return None
+        return body
 
     def _remirror(self, repo, pack_id: str, body: bytes) -> bool:
         """Heal the OTHER direction: primary verified clean, so make
@@ -348,6 +408,28 @@ class ScrubService:
         with span("scrub.heal"):
             repo.store.put(mirror_key(pack_id), body)
         return True
+
+    def _ec_backfill(self, repo, pack_id: str, blobs: dict,
+                     body: bytes) -> bool:
+        """Heal a proven stripe the other direction (the EC analogue of
+        _remirror): re-encode the verified body and re-publish every
+        shard that vanished or rotted. Write-new only — healthy shards
+        are byte-identical to the re-encode and never rewritten.
+        Returns True when any shard was (re)published."""
+        from volsync_tpu.repo import erasure
+
+        scheme = erasure.stripe_scheme(blobs)
+        if scheme is None:
+            return False
+        k, m = scheme
+        want = erasure.encode_pack_shards([body], k, m)
+        wrote = False
+        for idx, shard in enumerate(want):
+            if blobs.get(idx) != shard:
+                with span("scrub.heal"):
+                    repo.ec_publish_shard(pack_id, idx, shard)
+                wrote = True
+        return wrote
 
     # -- service loop ------------------------------------------------------
 
